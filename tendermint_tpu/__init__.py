@@ -1,0 +1,27 @@
+"""tendermint_tpu — a TPU-native Byzantine-fault-tolerant state machine replication framework.
+
+A from-scratch re-design of Tendermint Core (reference: tendermint v0.26.2, Go) for TPU
+hardware: the BFT control plane (consensus rounds, gossip, WAL, mempool) runs on host in
+asyncio Python, while the compute-dense data plane — Ed25519/secp256k1 signature
+verification, SHA hashing, Merkle trees — is batched onto TPU through JAX/Pallas kernels
+behind an explicit ``BatchVerifier`` boundary (``tendermint_tpu.crypto.batch``).
+
+Layer map (mirrors reference layer map, see SURVEY.md §1):
+
+  cmd/        CLI entrypoints
+  rpc/        JSON-RPC / WebSocket API
+  node/       composition root
+  consensus/  BFT state machine + gossip reactor + WAL
+  blockchain/ fast sync (batched multi-height commit verification — the TPU payoff)
+  mempool/ evidence/  tx + evidence pools
+  state/      block execution, stores, validation
+  abci/ proxy/  application interface (3 logical connections)
+  types/      Block, Vote, Commit, ValidatorSet, VoteSet, PartSet, EventBus
+  crypto/     host crypto: keys, merkle, multisig + the BatchVerifier boundary
+  ops/        TPU kernels: ed25519 batch verify, field/curve arithmetic, hashing
+  parallel/   device-mesh sharding of verification batches (pjit/shard_map)
+  p2p/        authenticated-encrypted multiplexed peer transport
+  libs/       runtime substrate: services, db, wal files, pubsub, bitarray
+"""
+
+from tendermint_tpu.version import __version__  # noqa: F401

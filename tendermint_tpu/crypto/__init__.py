@@ -1,0 +1,26 @@
+"""Crypto layer: key interfaces, hashing, merkle, multisig, and the BatchVerifier
+boundary that routes bulk signature batches to the TPU kernels in tendermint_tpu.ops.
+
+Reference: crypto/ (SURVEY.md §2.1 "Crypto").
+"""
+
+from tendermint_tpu.crypto.hashing import (  # noqa: F401
+    sha256,
+    sha512,
+    tmhash,
+    tmhash_truncated,
+    ripemd160,
+    HASH_SIZE,
+    TRUNCATED_SIZE,
+)
+from tendermint_tpu.crypto.keys import (  # noqa: F401
+    ADDRESS_SIZE,
+    PrivKey,
+    PrivKeyEd25519,
+    PrivKeySecp256k1,
+    PubKey,
+    PubKeyEd25519,
+    PubKeySecp256k1,
+    privkey_from_json_obj,
+    pubkey_from_json_obj,
+)

@@ -1,0 +1,131 @@
+"""The BatchVerifier boundary — the seam where bulk signature verification leaves
+the host control plane and lands on TPU.
+
+The reference (v0.26.2) has NO batch interface; its one call-site shape is
+``PubKey.VerifyBytes(msg, sig) bool`` (crypto/crypto.go:22-27), invoked serially
+from types/validator_set.go:281-296 (commit verify), types/vote.go:102 (per-vote),
+state/validation.go:102 and blockchain/reactor.go:306 (fast sync). This module
+introduces the batch boundary those call sites feed (SURVEY.md north star):
+callers collect (pubkey, msg, sig) tuples for a height — or a whole fast-sync
+window of heights — and dispatch them in ONE call.
+
+Backends:
+  * HostBatchVerifier  — serial host loop (CPU oracle; always available).
+  * TPUBatchVerifier   — tendermint_tpu.ops.ed25519_verify batched JAX kernel for
+    ed25519 items; non-ed25519 items (secp256k1, multisig) fall back to host.
+
+Accept/reject is bit-exact across backends (tests/test_ops_ed25519.py).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from tendermint_tpu.crypto import ed25519 as _ed
+from tendermint_tpu.crypto.keys import PubKey, PubKeyEd25519
+
+
+@dataclass(frozen=True)
+class SigItem:
+    """One signature-verification work item."""
+
+    pubkey: bytes  # raw 32-byte ed25519 key (or PubKey for generic items)
+    msg: bytes
+    sig: bytes
+
+
+class HostBatchVerifier:
+    """Serial host verification — the oracle backend."""
+
+    name = "host"
+
+    def verify_ed25519(self, items: Sequence[SigItem]) -> np.ndarray:
+        return np.array(
+            [_ed.verify(it.pubkey, it.msg, it.sig) for it in items], dtype=bool
+        )
+
+
+class TPUBatchVerifier:
+    """Batched device verification through the JAX kernel (ops/ed25519_verify)."""
+
+    name = "tpu"
+
+    def __init__(self, mesh=None):
+        # deferred import: keep jax out of pure-host users
+        from tendermint_tpu.ops import ed25519_verify as kernel
+
+        self._kernel = kernel
+        self._mesh = mesh
+
+    def verify_ed25519(self, items: Sequence[SigItem]) -> np.ndarray:
+        if len(items) == 0:
+            return np.zeros((0,), dtype=bool)
+        pubs = np.frombuffer(
+            b"".join(it.pubkey for it in items), dtype=np.uint8
+        ).reshape(len(items), 32)
+        sigs = np.frombuffer(
+            b"".join(it.sig for it in items), dtype=np.uint8
+        ).reshape(len(items), 64)
+        msgs = [it.msg for it in items]
+        return np.asarray(
+            self._kernel.verify_batch(pubs, msgs, sigs, mesh=self._mesh), dtype=bool
+        )
+
+
+_lock = threading.Lock()
+_default = None
+
+
+def get_batch_verifier(prefer_tpu: bool = True):
+    """Process-wide default verifier. TPU backend if jax is importable."""
+    global _default
+    with _lock:
+        if _default is None:
+            if prefer_tpu:
+                try:
+                    _default = TPUBatchVerifier()
+                except Exception:
+                    _default = HostBatchVerifier()
+            else:
+                _default = HostBatchVerifier()
+        return _default
+
+
+def set_batch_verifier(v) -> None:
+    global _default
+    with _lock:
+        _default = v
+
+
+def verify_items(items: Sequence[SigItem], verifier=None) -> np.ndarray:
+    """Verify a heterogeneous batch. Ed25519 raw items go to the batch backend."""
+    if verifier is None:
+        verifier = get_batch_verifier()
+    return verifier.verify_ed25519(items)
+
+
+def verify_generic(
+    pubkeys: Sequence[PubKey], msgs: Sequence[bytes], sigs: Sequence[bytes],
+    verifier=None,
+) -> np.ndarray:
+    """Batch-verify over PubKey objects: ed25519 keys batch to the device,
+    anything else (secp256k1, multisig) verifies on host."""
+    n = len(pubkeys)
+    out = np.zeros((n,), dtype=bool)
+    ed_idx: List[int] = []
+    ed_items: List[SigItem] = []
+    for i, pk in enumerate(pubkeys):
+        if isinstance(pk, PubKeyEd25519) and len(sigs[i]) == 64:
+            ed_idx.append(i)
+            ed_items.append(SigItem(pk.bytes(), msgs[i], sigs[i]))
+        else:
+            out[i] = pk.verify_bytes(msgs[i], sigs[i])
+    if ed_items:
+        res = verify_items(ed_items, verifier=verifier)
+        for j, i in enumerate(ed_idx):
+            out[i] = res[j]
+    return out

@@ -1,0 +1,253 @@
+"""Host-side Ed25519 with the *exact* accept/reject semantics of the Go reference.
+
+The reference (crypto/ed25519/ed25519.go:151) delegates to golang.org/x/crypto/ed25519,
+whose Verify has several non-RFC-8032 quirks that define our bit-exactness contract
+(BASELINE.md "accept/reject parity"):
+
+  * only the top 3 bits of s are checked (``sig[63]&224 != 0`` rejects), so scalars
+    s in [L, 2^253) are ACCEPTED — stricter libraries (OpenSSL) reject them;
+  * point decompression loads y as a 255-bit little-endian integer reduced mod p —
+    non-canonical encodings (y >= p) are ACCEPTED;
+  * the final check is a raw 32-byte comparison of the canonical encoding of
+    R' = [s]B - [h]A against sig[:32] (so a non-canonical R in the signature can
+    only match itself, never the canonical re-encoding).
+
+This module provides:
+  * ``verify`` — the oracle implementing exactly the above (pure-python bigint path,
+    with a fast-path through the `cryptography` package when inputs are in the
+    canonical zone where both libraries agree);
+  * ``sign`` / key generation — RFC 8032 standard (identical to Go's Sign);
+  * curve constants and reference point arithmetic reused by tests of the TPU kernel
+    (tendermint_tpu/ops/ed25519_verify.py).
+
+Key layout mirrors the reference: PrivKey = 64 bytes (seed || pubkey),
+PubKey = 32 bytes, Signature = 64 bytes, Address = SHA256(pubkey)[:20]
+(crypto/ed25519/ed25519.go:138, crypto/tmhash/hash.go:62).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+from typing import Optional, Tuple
+
+try:  # fast host path for sign + canonical-zone verify
+    from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+        Ed25519PrivateKey,
+        Ed25519PublicKey,
+    )
+    from cryptography.exceptions import InvalidSignature
+
+    _HAVE_CRYPTOGRAPHY = True
+except Exception:  # pragma: no cover
+    _HAVE_CRYPTOGRAPHY = False
+
+# ---------------------------------------------------------------------------
+# Curve constants (edwards25519: -x^2 + y^2 = 1 + d x^2 y^2 over GF(2^255-19))
+# ---------------------------------------------------------------------------
+
+P = 2**255 - 19
+L = 2**252 + 27742317777372353535851937790883648493  # group order
+D = (-121665 * pow(121666, P - 2, P)) % P
+D2 = (2 * D) % P
+SQRT_M1 = pow(2, (P - 1) // 4, P)  # sqrt(-1)
+
+# base point
+_BY = (4 * pow(5, P - 2, P)) % P
+_BX = None  # resolved below
+
+
+def _decompress_xy(s: bytes) -> Optional[Tuple[int, int]]:
+    """Mirror of Go's ExtendedGroupElement.FromBytes: returns affine (x, y) or None.
+
+    Accepts non-canonical y (reduced mod p); sign bit selects the x parity.
+    """
+    y_raw = int.from_bytes(s, "little")
+    sign = (y_raw >> 255) & 1
+    y = (y_raw & ((1 << 255) - 1)) % P
+    u = (y * y - 1) % P
+    v = (D * y * y + 1) % P
+    # candidate root x = u v^3 (u v^7)^((p-5)/8)
+    x = (u * pow(v, 3, P) * pow((u * pow(v, 7, P)) % P, (P - 5) // 8, P)) % P
+    vxx = (v * x * x) % P
+    if (vxx - u) % P != 0:
+        if (vxx + u) % P != 0:
+            return None
+        x = (x * SQRT_M1) % P
+    if (x & 1) != sign:
+        x = (P - x) % P
+    return (x, y)
+
+
+_B_PT = _decompress_xy(_BY.to_bytes(32, "little"))
+assert _B_PT is not None
+# base point B: y = 4/5, x even (sign bit clear in the canonical encoding)
+B_AFFINE = _B_PT[0]
+del _B_PT
+
+# ---------------------------------------------------------------------------
+# Extended-coordinate point arithmetic with the complete addition law.
+# (a = -1 is a square mod p and d is non-square, so the law is complete for
+#  every point on the curve, including low-order/adversarial points.)
+# ---------------------------------------------------------------------------
+
+# point = (X, Y, Z, T) with x = X/Z, y = Y/Z, T = XY/Z
+IDENT = (0, 1, 1, 0)
+
+
+def _to_extended(pt: Tuple[int, int]) -> Tuple[int, int, int, int]:
+    x, y = pt
+    return (x, y, 1, (x * y) % P)
+
+
+def pt_add(p1, p2):
+    """add-2008-hwcd-3 (complete for a=-1, d non-square)."""
+    X1, Y1, Z1, T1 = p1
+    X2, Y2, Z2, T2 = p2
+    A = ((Y1 - X1) * (Y2 - X2)) % P
+    Bv = ((Y1 + X1) * (Y2 + X2)) % P
+    C = (T1 * D2 % P) * T2 % P
+    Dv = (Z1 * 2 * Z2) % P
+    E = (Bv - A) % P
+    F = (Dv - C) % P
+    G = (Dv + C) % P
+    H = (Bv + A) % P
+    return ((E * F) % P, (G * H) % P, (F * G) % P, (E * H) % P)
+
+
+def pt_double(p1):
+    """dbl-2008-hwcd, valid for all inputs."""
+    X1, Y1, Z1, _ = p1
+    A = (X1 * X1) % P
+    Bv = (Y1 * Y1) % P
+    C = (2 * Z1 * Z1) % P
+    H = (A + Bv) % P
+    E = (H - (X1 + Y1) * (X1 + Y1)) % P
+    G = (A - Bv) % P
+    F = (C + G) % P
+    return ((E * F) % P, (G * H) % P, (F * G) % P, (E * H) % P)
+
+
+def pt_scalar_mult(pt, k: int):
+    acc = IDENT
+    base = pt
+    while k:
+        if k & 1:
+            acc = pt_add(acc, base)
+        base = pt_double(base)
+        k >>= 1
+    return acc
+
+
+def pt_encode(p1) -> bytes:
+    X, Y, Z, _ = p1
+    zi = pow(Z, P - 2, P)
+    x = (X * zi) % P
+    y = (Y * zi) % P
+    return (y | ((x & 1) << 255)).to_bytes(32, "little")
+
+
+B_EXT = _to_extended((B_AFFINE, _BY))
+
+# ---------------------------------------------------------------------------
+# Verify / sign
+# ---------------------------------------------------------------------------
+
+
+def _verify_pure(public_key: bytes, message: bytes, sig: bytes) -> bool:
+    """Literal mirror of golang.org/x/crypto/ed25519.Verify."""
+    if len(public_key) != 32 or len(sig) != 64:
+        return False
+    if sig[63] & 224 != 0:
+        return False
+    A = _decompress_xy(public_key)
+    if A is None:
+        return False
+    # negate A (Go negates X and T after FromBytes)
+    neg_a = ((P - A[0]) % P, A[1])
+    h = int.from_bytes(
+        hashlib.sha512(sig[:32] + public_key + message).digest(), "little"
+    ) % L
+    s = int.from_bytes(sig[32:], "little")
+    r_check = pt_add(
+        pt_scalar_mult(_to_extended(neg_a), h), pt_scalar_mult(B_EXT, s)
+    )
+    return pt_encode(r_check) == sig[:32]
+
+
+def _in_canonical_zone(public_key: bytes, sig: bytes) -> bool:
+    """True when stricter RFC-8032 verifiers (OpenSSL) agree with the Go semantics:
+    s < L, and both the pubkey y and the R y-coordinate are canonical (< p)."""
+    s = int.from_bytes(sig[32:], "little")
+    if s >= L:
+        return False
+    y_pub = int.from_bytes(public_key, "little") & ((1 << 255) - 1)
+    y_r = int.from_bytes(sig[:32], "little") & ((1 << 255) - 1)
+    return y_pub < P and y_r < P
+
+
+def verify(public_key: bytes, message: bytes, sig: bytes) -> bool:
+    """Go-exact single verify. Fast path through OpenSSL when inputs are canonical."""
+    if len(public_key) != 32 or len(sig) != 64 or sig[63] & 224 != 0:
+        return False
+    if _HAVE_CRYPTOGRAPHY and _in_canonical_zone(public_key, sig):
+        try:
+            Ed25519PublicKey.from_public_bytes(public_key).verify(sig, message)
+            return True
+        except InvalidSignature:
+            return False
+        except ValueError:
+            # e.g. pubkey decompression failure — fall back to oracle semantics
+            return _verify_pure(public_key, message, sig)
+    return _verify_pure(public_key, message, sig)
+
+
+def sign(private_key: bytes, message: bytes) -> bytes:
+    """RFC 8032 sign; private_key is the 64-byte Go layout (seed || pubkey)."""
+    if len(private_key) != 64:
+        raise ValueError("ed25519 private key must be 64 bytes (seed || pubkey)")
+    seed = private_key[:32]
+    if _HAVE_CRYPTOGRAPHY:
+        return Ed25519PrivateKey.from_private_bytes(seed).sign(message)
+    return _sign_pure(seed, message)
+
+
+def _sign_pure(seed: bytes, message: bytes) -> bytes:
+    h = hashlib.sha512(seed).digest()
+    a = int.from_bytes(h[:32], "little")
+    a &= (1 << 254) - 8
+    a |= 1 << 254
+    prefix = h[32:]
+    A_enc = pt_encode(pt_scalar_mult(B_EXT, a))
+    r = int.from_bytes(hashlib.sha512(prefix + message).digest(), "little") % L
+    R_enc = pt_encode(pt_scalar_mult(B_EXT, r))
+    k = int.from_bytes(hashlib.sha512(R_enc + A_enc + message).digest(), "little") % L
+    s = (r + k * a) % L
+    return R_enc + s.to_bytes(32, "little")
+
+
+def pubkey_from_seed(seed: bytes) -> bytes:
+    if _HAVE_CRYPTOGRAPHY:
+        from cryptography.hazmat.primitives import serialization
+
+        return (
+            Ed25519PrivateKey.from_private_bytes(seed)
+            .public_key()
+            .public_bytes(
+                serialization.Encoding.Raw, serialization.PublicFormat.Raw
+            )
+        )
+    h = hashlib.sha512(seed).digest()
+    a = int.from_bytes(h[:32], "little")
+    a &= (1 << 254) - 8
+    a |= 1 << 254
+    return pt_encode(pt_scalar_mult(B_EXT, a))
+
+
+def gen_privkey(seed: Optional[bytes] = None) -> bytes:
+    """64-byte private key (seed || pubkey), mirroring Go's NewKeyFromSeed layout."""
+    if seed is None:
+        seed = os.urandom(32)
+    if len(seed) != 32:
+        raise ValueError("seed must be 32 bytes")
+    return seed + pubkey_from_seed(seed)

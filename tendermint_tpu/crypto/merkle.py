@@ -1,0 +1,131 @@
+"""Merkle simple tree + proofs (host path).
+
+Mirrors reference crypto/merkle/simple_tree.go:23 and simple_proof.go:70 in
+capability. Structural deviation (documented, intentional — we are not
+amino-wire-compatible, SURVEY.md §7.2): we use RFC-6962-style domain separation
+(0x00 leaf prefix, 0x01 inner prefix, empty tree = SHA256("")) which prevents
+second-preimage attacks that the reference's bare concatenation is exposed to,
+and we split at the largest power of two (balanced trees compile better onto the
+TPU batched-hash kernel in ops/). Proof verification matches this layout.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass, field
+from typing import List, Optional, Sequence
+
+_LEAF_PREFIX = b"\x00"
+_INNER_PREFIX = b"\x01"
+
+
+def _hash(data: bytes) -> bytes:
+    return hashlib.sha256(data).digest()
+
+
+def leaf_hash(leaf: bytes) -> bytes:
+    return _hash(_LEAF_PREFIX + leaf)
+
+
+def inner_hash(left: bytes, right: bytes) -> bytes:
+    return _hash(_INNER_PREFIX + left + right)
+
+
+def _split_point(n: int) -> int:
+    """largest power of two strictly less than n"""
+    k = 1
+    while k * 2 < n:
+        k *= 2
+    return k
+
+
+def hash_from_byte_slices(items: Sequence[bytes]) -> bytes:
+    """Merkle root of a list of byte slices (cf. SimpleHashFromByteSlices)."""
+    n = len(items)
+    if n == 0:
+        return _hash(b"")
+    if n == 1:
+        return leaf_hash(items[0])
+    k = _split_point(n)
+    return inner_hash(hash_from_byte_slices(items[:k]), hash_from_byte_slices(items[k:]))
+
+
+def hash_from_map(m: dict) -> bytes:
+    """Root over sorted key/value pairs (cf. merkle/simple_map.go)."""
+    items = [
+        leaf_kv(k if isinstance(k, bytes) else str(k).encode(), v)
+        for k, v in sorted(m.items())
+    ]
+    return hash_from_byte_slices(items)
+
+
+def leaf_kv(key: bytes, value: bytes) -> bytes:
+    return len(key).to_bytes(4, "big") + key + value
+
+
+@dataclass
+class SimpleProof:
+    """Inclusion proof (cf. reference merkle/simple_proof.go:16)."""
+
+    total: int
+    index: int
+    leaf_hash: bytes
+    aunts: List[bytes] = field(default_factory=list)
+
+    def compute_root(self) -> Optional[bytes]:
+        return _compute_from_aunts(self.index, self.total, self.leaf_hash, self.aunts)
+
+    def verify(self, root: bytes, leaf: bytes) -> bool:
+        if self.total <= 0 or not (0 <= self.index < self.total):
+            return False
+        if self.leaf_hash != leaf_hash(leaf):
+            return False
+        return self.compute_root() == root
+
+
+def _compute_from_aunts(
+    index: int, total: int, lh: bytes, aunts: List[bytes]
+) -> Optional[bytes]:
+    if total == 1:
+        if aunts:
+            return None
+        return lh
+    if not aunts:
+        return None
+    k = _split_point(total)
+    if index < k:
+        left = _compute_from_aunts(index, k, lh, aunts[:-1])
+        if left is None:
+            return None
+        return inner_hash(left, aunts[-1])
+    right = _compute_from_aunts(index - k, total - k, lh, aunts[:-1])
+    if right is None:
+        return None
+    return inner_hash(aunts[-1], right)
+
+
+def proofs_from_byte_slices(items: Sequence[bytes]) -> tuple[bytes, List[SimpleProof]]:
+    """Build root + per-leaf proofs (cf. SimpleProofsFromByteSlices)."""
+    n = len(items)
+    lhs = [leaf_hash(it) for it in items]
+    proofs = [SimpleProof(total=n, index=i, leaf_hash=lhs[i]) for i in range(n)]
+
+    def build(lo: int, hi: int) -> bytes:
+        cnt = hi - lo
+        if cnt == 0:
+            return _hash(b"")
+        if cnt == 1:
+            return lhs[lo]
+        k = _split_point(cnt)
+        left = build(lo, lo + k)
+        right = build(lo + k, hi)
+        for i in range(lo, lo + k):
+            proofs[i].aunts.append(right)
+        for i in range(lo + k, hi):
+            proofs[i].aunts.append(left)
+        return inner_hash(left, right)
+
+    root = build(0, n)
+    # aunts were appended root-last during recursion unwinding; they are built
+    # leaf-up already because recursion appends at each level after subcalls.
+    return root, proofs

@@ -1,0 +1,8 @@
+"""Version info. Mirrors reference version/version.go:21 semantics (semver + protocol versions)."""
+
+__version__ = "0.1.0"
+
+# Protocol versions, bumped on incompatible changes (reference version/version.go:36-44).
+BLOCK_PROTOCOL = 1
+P2P_PROTOCOL = 1
+APP_INTERFACE_VERSION = 1

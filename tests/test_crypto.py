@@ -1,0 +1,247 @@
+"""Host crypto layer tests: ed25519 oracle vs RFC 8032 vectors + adversarial
+accept/reject edge cases, secp256k1, merkle, multisig, hashing."""
+
+import hashlib
+
+import pytest
+
+from tendermint_tpu.crypto import ed25519 as ed
+from tendermint_tpu.crypto import secp256k1 as secp
+from tendermint_tpu.crypto import merkle
+from tendermint_tpu.crypto.hashing import ripemd160, _ripemd160_py, tmhash_truncated
+from tendermint_tpu.crypto.keys import (
+    PrivKeyEd25519,
+    PrivKeySecp256k1,
+    PubKeyEd25519,
+    pubkey_from_json_obj,
+)
+from tendermint_tpu.crypto.multisig import (
+    CompactBitArray,
+    Multisignature,
+    PubKeyMultisigThreshold,
+)
+
+# RFC 8032 test vectors (seed, pubkey, msg, sig) — TEST1..TEST3 + SHA(abc)
+RFC8032 = [
+    (
+        "9d61b19deffd5a60ba844af492ec2cc44449c5697b326919703bac031cae7f60",
+        "d75a980182b10ab7d54bfed3c964073a0ee172f3daa62325af021a68f707511a",
+        "",
+        "e5564300c360ac729086e2cc806e828a84877f1eb8e5d974d873e06522490155"
+        "5fb8821590a33bacc61e39701cf9b46bd25bf5f0595bbe24655141438e7a100b",
+    ),
+    (
+        "4ccd089b28ff96da9db6c346ec114e0f5b8a319f35aba624da8cf6ed4fb8a6fb",
+        "3d4017c3e843895a92b70aa74d1b7ebc9c982ccf2ec4968cc0cd55f12af4660c",
+        "72",
+        "92a009a9f0d4cab8720e820b5f642540a2b27b5416503f8fb3762223ebdb69da"
+        "085ac1e43e15996e458f3613d0f11d8c387b2eaeb4302aeeb00d291612bb0c00",
+    ),
+    (
+        "c5aa8df43f9f837bedb7442f31dcb7b166d38535076f094b85ce3a2e0b4458f7",
+        "fc51cd8e6218a1a38da47ed00230f0580816ed13ba3303ac5deb911548908025",
+        "af82",
+        "6291d657deec24024827e69c3abe01a30ce548a284743a445e3680d7db5ac3ac"
+        "18ff9b538d16f290ae67f760984dc6594a7c15e9716ed28dc027beceea1ec40a",
+    ),
+]
+
+
+class TestEd25519:
+    @pytest.mark.parametrize("seed,pub,msg,sig", RFC8032)
+    def test_rfc8032_sign_verify(self, seed, pub, msg, sig):
+        seed_b = bytes.fromhex(seed)
+        msg_b = bytes.fromhex(msg)
+        priv = ed.gen_privkey(seed_b)
+        assert priv[32:] == bytes.fromhex(pub)
+        assert ed.sign(priv, msg_b) == bytes.fromhex(sig)
+        assert ed._sign_pure(seed_b, msg_b) == bytes.fromhex(sig)
+        assert ed.verify(bytes.fromhex(pub), msg_b, bytes.fromhex(sig))
+        assert ed._verify_pure(bytes.fromhex(pub), msg_b, bytes.fromhex(sig))
+
+    def test_reject_wrong_msg_and_corrupt_sig(self):
+        priv = ed.gen_privkey(b"\x07" * 32)
+        pub = priv[32:]
+        sig = ed.sign(priv, b"hello")
+        assert ed.verify(pub, b"hello", sig)
+        assert not ed.verify(pub, b"hellp", sig)
+        for i in (0, 31, 32, 63):
+            bad = bytearray(sig)
+            bad[i] ^= 1
+            assert not ed.verify(pub, b"hello", bytes(bad))
+
+    def test_top_bits_malleability_check(self):
+        """Go rejects iff sig[63]&224 != 0; s in [L, 2^253) is accepted."""
+        priv = ed.gen_privkey(b"\x01" * 32)
+        pub = priv[32:]
+        sig = ed.sign(priv, b"m")
+        s = int.from_bytes(sig[32:], "little")
+        # add L: stays < 2^253, still passes the curve equation
+        s_mall = s + ed.L
+        assert s_mall < 2**253
+        sig_mall = sig[:32] + s_mall.to_bytes(32, "little")
+        assert ed._verify_pure(pub, b"m", sig_mall), "Go semantics accept s+L"
+        assert ed.verify(pub, b"m", sig_mall)
+        # but setting any of the top 3 bits rejects immediately
+        bad = bytearray(sig)
+        bad[63] |= 0x20
+        assert not ed.verify(pub, b"m", bytes(bad))
+
+    def test_noncanonical_pubkey_y_accepted(self):
+        """Go loads y as a 255-bit int reduced mod p: the encodings of y and
+        y+p (both < 2^255) decompress to the same point. Only y < 19 admits a
+        non-canonical twin, so probe the handful of small decompressable ys."""
+        found = 0
+        for y in range(19):
+            enc = y.to_bytes(32, "little")
+            pt = ed._decompress_xy(enc)
+            if pt is None:
+                continue
+            found += 1
+            twin = (y + ed.P).to_bytes(32, "little")
+            assert ed._decompress_xy(twin) == pt
+            # and with the sign bit set on both encodings
+            enc_s = (y | (1 << 255)).to_bytes(32, "little")
+            twin_s = ((y + ed.P) | (1 << 255)).to_bytes(32, "little")
+            assert ed._decompress_xy(twin_s) == ed._decompress_xy(enc_s)
+        assert found > 0
+
+    def test_invalid_pubkey_decompress_rejected(self):
+        # y=2 has (y^2-1)/(dy^2+1) a non-square -> decompression must fail
+        candidates = 0
+        for y in range(2, 50):
+            enc = y.to_bytes(32, "little")
+            if ed._decompress_xy(enc) is None:
+                candidates += 1
+                assert not ed.verify(enc, b"m", b"\x00" * 64)
+        assert candidates > 0
+
+    def test_keys_interface(self):
+        pk = PrivKeyEd25519.generate(b"\x05" * 32)
+        pub = pk.pub_key()
+        assert len(pub.address()) == 20
+        assert pub.address() == hashlib.sha256(pub.bytes()).digest()[:20]
+        sig = pk.sign(b"payload")
+        assert pub.verify_bytes(b"payload", sig)
+        assert not pub.verify_bytes(b"payload2", sig)
+        # json round trip
+        obj = pub.to_json_obj()
+        assert pubkey_from_json_obj(obj).equals(pub)
+
+
+class TestSecp256k1:
+    def test_sign_verify_roundtrip(self):
+        pk = PrivKeySecp256k1.generate(b"\x11" * 32)
+        pub = pk.pub_key()
+        sig = pk.sign(b"tx data")
+        assert pub.verify_bytes(b"tx data", sig)
+        assert not pub.verify_bytes(b"tx datb", sig)
+        assert len(pub.address()) == 20
+
+    def test_deterministic_signatures(self):
+        pk = PrivKeySecp256k1.generate(b"\x12" * 32)
+        assert pk.sign(b"m") == pk.sign(b"m")
+
+    def test_high_s_rejected(self):
+        pk = PrivKeySecp256k1.generate(b"\x13" * 32)
+        digest = hashlib.sha256(b"m").digest()
+        sig = secp.sign(pk.bytes(), digest)
+        r, s = secp.der_decode_sig(sig)
+        assert s <= secp.N // 2
+        high = secp.der_encode_sig(r, secp.N - s)
+        assert not secp.verify(pk.pub_key().bytes(), digest, high)
+
+    def test_bad_pubkey(self):
+        assert secp.decompress_pubkey(b"\x04" + b"\x01" * 32) is None
+        assert not secp.verify(b"\x02" + b"\xff" * 32, b"\x00" * 32, b"\x30\x00")
+
+
+class TestMerkle:
+    def test_roots_change_with_items(self):
+        a = merkle.hash_from_byte_slices([b"a", b"b", b"c"])
+        b = merkle.hash_from_byte_slices([b"a", b"b", b"d"])
+        c = merkle.hash_from_byte_slices([b"a", b"b"])
+        assert a != b != c
+        assert merkle.hash_from_byte_slices([]) == hashlib.sha256(b"").digest()
+
+    @pytest.mark.parametrize("n", [1, 2, 3, 4, 5, 7, 8, 9, 33])
+    def test_proofs(self, n):
+        items = [bytes([i]) * (i + 1) for i in range(n)]
+        root, proofs = merkle.proofs_from_byte_slices(items)
+        assert root == merkle.hash_from_byte_slices(items)
+        for i, proof in enumerate(proofs):
+            assert proof.verify(root, items[i])
+            assert not proof.verify(root, items[i] + b"!")
+            if n > 1:
+                other = items[(i + 1) % n]
+                assert not proof.verify(root, other)
+
+    def test_second_preimage_domain_separation(self):
+        # leaf hash and inner hash domains must differ
+        assert merkle.leaf_hash(b"xy") != merkle.inner_hash(b"x", b"y")
+
+
+class TestMultisig:
+    def _keys(self, n):
+        privs = [PrivKeyEd25519.generate(bytes([i + 1]) * 32) for i in range(n)]
+        return privs, [p.pub_key() for p in privs]
+
+    def test_threshold_verify(self):
+        privs, pubs = self._keys(5)
+        mpk = PubKeyMultisigThreshold(k=3, pubkeys=tuple(pubs))
+        msg = b"multisig message"
+        ms = Multisignature.new(5)
+        for i in (0, 2, 4):
+            ms.add_signature_from_pubkey(privs[i].sign(msg), pubs[i], pubs)
+        assert mpk.verify_bytes(msg, ms.marshal())
+        # below threshold
+        ms2 = Multisignature.new(5)
+        for i in (1, 3):
+            ms2.add_signature_from_pubkey(privs[i].sign(msg), pubs[i], pubs)
+        assert not mpk.verify_bytes(msg, ms2.marshal())
+        # one bad signature among three
+        ms3 = Multisignature.new(5)
+        ms3.add_signature_from_pubkey(privs[0].sign(msg), pubs[0], pubs)
+        ms3.add_signature_from_pubkey(privs[2].sign(b"other"), pubs[2], pubs)
+        ms3.add_signature_from_pubkey(privs[4].sign(msg), pubs[4], pubs)
+        assert not mpk.verify_bytes(msg, ms3.marshal())
+
+    def test_flatten_for_batch(self):
+        privs, pubs = self._keys(4)
+        mpk = PubKeyMultisigThreshold(k=2, pubkeys=tuple(pubs))
+        msg = b"zz"
+        ms = Multisignature.new(4)
+        ms.add_signature_from_pubkey(privs[1].sign(msg), pubs[1], pubs)
+        ms.add_signature_from_pubkey(privs[3].sign(msg), pubs[3], pubs)
+        flat = mpk.flatten(msg, ms.marshal())
+        assert flat is not None and len(flat) == 2
+        from tendermint_tpu.crypto import ed25519 as ed
+
+        assert all(ed.verify(pk, m, s) for pk, m, s in flat)
+
+    def test_compact_bitarray(self):
+        ba = CompactBitArray(10)
+        ba.set_index(3, True)
+        ba.set_index(9, True)
+        assert ba.get_index(3) and ba.get_index(9) and not ba.get_index(4)
+        assert ba.count() == 2
+        assert ba.num_true_bits_before(9) == 1
+        rt = CompactBitArray.from_bytes(ba.to_bytes())
+        assert rt == ba
+
+
+class TestHashing:
+    def test_ripemd160_known_vectors(self):
+        # official RIPEMD-160 test vectors
+        vecs = {
+            b"": "9c1185a5c5e9fc54612808977ee8f548b2258d31",
+            b"a": "0bdc9d2d256b3ee9daae347be6f4dc835a467ffe",
+            b"abc": "8eb208f7e05d987a9b044a8e98c6b087f15a0bfc",
+            b"message digest": "5d0689ef49d2fae572b881b123a85ffa21595f36",
+        }
+        for msg, want in vecs.items():
+            assert _ripemd160_py(msg).hex() == want
+            assert ripemd160(msg).hex() == want
+
+    def test_truncated(self):
+        assert len(tmhash_truncated(b"data")) == 20
