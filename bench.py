@@ -1,0 +1,70 @@
+"""Headline benchmark: 10,000-validator ed25519 commit verification.
+
+Reference cost model: one serial host ed25519 verify per precommit
+(`/root/reference/types/validator_set.go:273-298`) — measured here as the
+baseline on this same machine (same library fast path the Go fork's pure-Go
+code is *slower* than, so the comparison flatters the reference).
+
+Prints ONE JSON line:
+  {"metric": "...", "value": N, "unit": "...", "vs_baseline": N}
+value = p50 wall-clock of one full batched dispatch (host prologue included),
+vs_baseline = baseline_time / our_time (higher is better).
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+N_VALIDATORS = 10_000
+MSG_LEN = 110  # ~ canonical vote sign-bytes size
+BASELINE_SAMPLE = 2_000  # serial host verifies to time (extrapolated to N)
+
+
+def main():
+    from tendermint_tpu.crypto import ed25519 as ed
+    from tendermint_tpu.ops import ed25519_verify as kernel
+
+    rng = np.random.default_rng(42)
+    seeds = rng.bytes(32 * N_VALIDATORS)
+    pubs = np.zeros((N_VALIDATORS, 32), np.uint8)
+    sigs = np.zeros((N_VALIDATORS, 64), np.uint8)
+    msgs = []
+    for i in range(N_VALIDATORS):
+        priv = ed.gen_privkey(seeds[32 * i : 32 * (i + 1)])
+        msg = bytes([i & 0xFF, (i >> 8) & 0xFF]) * (MSG_LEN // 2)
+        pubs[i] = np.frombuffer(priv[32:], np.uint8)
+        sigs[i] = np.frombuffer(ed.sign(priv, msg), np.uint8)
+        msgs.append(msg)
+
+    # --- baseline: the reference's serial-verify loop shape ---
+    t0 = time.perf_counter()
+    for i in range(BASELINE_SAMPLE):
+        ed.verify(pubs[i].tobytes(), msgs[i], sigs[i].tobytes())
+    baseline_s = (time.perf_counter() - t0) * (N_VALIDATORS / BASELINE_SAMPLE)
+
+    # --- batched device path: warm up (compile + decompress cache), then p50 ---
+    ok = kernel.verify_batch(pubs, msgs, sigs)
+    assert bool(ok.all()), "batched verify rejected a valid commit"
+    times = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        kernel.verify_batch(pubs, msgs, sigs)
+        times.append(time.perf_counter() - t0)
+    ours_s = float(np.median(times))
+
+    print(
+        json.dumps(
+            {
+                "metric": "ed25519_commit_verify_10k_validators",
+                "value": round(ours_s * 1e3, 3),
+                "unit": "ms",
+                "vs_baseline": round(baseline_s / ours_s, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,199 @@
+"""Deterministic binary codec — this framework's replacement for go-amino.
+
+The reference encodes consensus-critical structures with go-amino
+(`/root/reference/types/canonical.go`, wire registration at
+`consensus/reactor.go:1379`).  Amino compatibility is a non-goal (SURVEY.md §7
+step 2): what matters is *determinism* (same struct → same bytes, signed by
+every validator) and self-delimiting frames.  This codec is deliberately tiny:
+
+  * uvarint / svarint (LEB128, zig-zag) — same wire primitives amino uses;
+  * length-prefixed byte strings;
+  * fixed64 little-endian for consensus heights/rounds/timestamps (mirroring
+    the `binary:"fixed64"` tags on CanonicalVote — fixed width removes any
+    encoder freedom for the hot signed fields);
+  * a struct layer: fields encoded in declaration order, each as
+    (field-number uvarint, payload) with the struct length-prefixed.
+
+Timestamps are int64 UNIX nanoseconds throughout the framework (the reference
+uses Go time.Time; RFC3339 canonical strings only ever existed for amino's
+benefit — nanos are already canonical).
+"""
+
+from __future__ import annotations
+
+import io
+import struct
+from typing import List, Sequence, Tuple
+
+
+def write_uvarint(buf: io.BytesIO, n: int) -> None:
+    if n < 0:
+        raise ValueError("uvarint must be non-negative")
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            buf.write(bytes([b | 0x80]))
+        else:
+            buf.write(bytes([b]))
+            return
+
+
+def encode_uvarint(n: int) -> bytes:
+    buf = io.BytesIO()
+    write_uvarint(buf, n)
+    return buf.getvalue()
+
+
+def read_uvarint(buf: io.BytesIO) -> int:
+    shift = 0
+    out = 0
+    while True:
+        ch = buf.read(1)
+        if not ch:
+            raise EOFError("truncated uvarint")
+        b = ch[0]
+        out |= (b & 0x7F) << shift
+        if not (b & 0x80):
+            return out
+        shift += 7
+        if shift > 70:
+            raise ValueError("uvarint too long")
+
+
+def encode_svarint(n: int) -> bytes:
+    # zig-zag
+    return encode_uvarint((n << 1) ^ (n >> 63) if n < 0 else n << 1)
+
+
+def read_svarint(buf: io.BytesIO) -> int:
+    u = read_uvarint(buf)
+    return (u >> 1) ^ -(u & 1)
+
+
+def encode_fixed64(n: int) -> bytes:
+    return struct.pack("<q", n)
+
+
+def read_fixed64(buf: io.BytesIO) -> int:
+    data = buf.read(8)
+    if len(data) != 8:
+        raise EOFError("truncated fixed64")
+    return struct.unpack("<q", data)[0]
+
+
+def encode_bytes(b: bytes) -> bytes:
+    return encode_uvarint(len(b)) + bytes(b)
+
+
+def read_bytes(buf: io.BytesIO) -> bytes:
+    n = read_uvarint(buf)
+    data = buf.read(n)
+    if len(data) != n:
+        raise EOFError("truncated bytes")
+    return data
+
+
+def encode_string(s: str) -> bytes:
+    return encode_bytes(s.encode("utf-8"))
+
+
+def read_string(buf: io.BytesIO) -> str:
+    return read_bytes(buf).decode("utf-8")
+
+
+def encode_bool(v: bool) -> bytes:
+    return b"\x01" if v else b"\x00"
+
+
+def read_bool(buf: io.BytesIO) -> bool:
+    ch = buf.read(1)
+    if not ch:
+        raise EOFError("truncated bool")
+    return ch[0] != 0
+
+
+def length_prefix(payload: bytes) -> bytes:
+    """Self-delimiting frame (amino's MarshalBinaryLengthPrefixed shape)."""
+    return encode_uvarint(len(payload)) + payload
+
+
+def read_length_prefixed(buf: io.BytesIO) -> bytes:
+    return read_bytes(buf)
+
+
+class Writer:
+    """Ordered-field struct writer; every encoder in types/ uses this."""
+
+    def __init__(self) -> None:
+        self._buf = io.BytesIO()
+
+    def uvarint(self, n: int) -> "Writer":
+        write_uvarint(self._buf, n)
+        return self
+
+    def svarint(self, n: int) -> "Writer":
+        self._buf.write(encode_svarint(n))
+        return self
+
+    def fixed64(self, n: int) -> "Writer":
+        self._buf.write(encode_fixed64(n))
+        return self
+
+    def bytes(self, b: bytes) -> "Writer":
+        self._buf.write(encode_bytes(b))
+        return self
+
+    def string(self, s: str) -> "Writer":
+        self._buf.write(encode_string(s))
+        return self
+
+    def bool(self, v: bool) -> "Writer":
+        self._buf.write(encode_bool(v))
+        return self
+
+    def raw(self, b: bytes) -> "Writer":
+        self._buf.write(b)
+        return self
+
+    def build(self) -> bytes:
+        return self._buf.getvalue()
+
+
+class Reader:
+    def __init__(self, data: bytes) -> None:
+        self._buf = io.BytesIO(data)
+
+    def uvarint(self) -> int:
+        return read_uvarint(self._buf)
+
+    def svarint(self) -> int:
+        return read_svarint(self._buf)
+
+    def fixed64(self) -> int:
+        return read_fixed64(self._buf)
+
+    def bytes(self) -> bytes:
+        return read_bytes(self._buf)
+
+    def string(self) -> str:
+        return read_string(self._buf)
+
+    def bool(self) -> bool:
+        return read_bool(self._buf)
+
+    def raw(self, n: int) -> bytes:
+        data = self._buf.read(n)
+        if len(data) != n:
+            raise EOFError("truncated raw read")
+        return data
+
+    def remaining(self) -> int:
+        pos = self._buf.tell()
+        self._buf.seek(0, io.SEEK_END)
+        end = self._buf.tell()
+        self._buf.seek(pos)
+        return end - pos
+
+    def at_end(self) -> bool:
+        return self.remaining() == 0

@@ -1,16 +1,23 @@
 """Test harness config: force a virtual 8-device CPU mesh so multi-chip sharding
 paths are exercised without TPU hardware (the driver separately dry-runs the real
-multichip path via __graft_entry__.dryrun_multichip)."""
+multichip path via __graft_entry__.dryrun_multichip).
+
+NOTE: this environment's sitecustomize force-registers the 'axon' TPU platform
+and overrides the JAX_PLATFORMS env var, so we must force CPU through
+jax.config *after* import, not via the environment alone."""
 
 import os
+import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
 
-import sys
+import jax
+
+jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
