@@ -82,6 +82,26 @@ class SimpleProof:
             return False
         return self.compute_root() == root
 
+    def encode(self, w) -> None:
+        w.uvarint(self.total).uvarint(self.index).bytes(self.leaf_hash)
+        w.uvarint(len(self.aunts))
+        for a in self.aunts:
+            w.bytes(a)
+
+    MAX_AUNTS = 128  # tree depth bound (2^128 leaves is unreachable); caps
+    # attacker-controlled allocation on the gossip decode path
+
+    @classmethod
+    def decode(cls, r) -> "SimpleProof":
+        total = r.uvarint()
+        index = r.uvarint()
+        lh = r.bytes()
+        n = r.uvarint()
+        if n > cls.MAX_AUNTS:
+            raise ValueError(f"proof claims {n} aunts (max {cls.MAX_AUNTS})")
+        aunts = [r.bytes() for _ in range(n)]
+        return cls(total=total, index=index, leaf_hash=lh, aunts=aunts)
+
 
 def _compute_from_aunts(
     index: int, total: int, lh: bytes, aunts: List[bytes]

@@ -1,0 +1,264 @@
+"""KV database abstraction (ref: libs/db/db.go, types.go).
+
+Backends:
+  * MemDB      — sorted in-memory dict (ref memdb.go); test default
+  * SQLiteDB   — durable single-file store on sqlite3 (stdlib) — fills the
+                 role of the reference's default goleveldb backend
+  * PrefixDB   — namespaced view over another DB (ref prefix_db.go)
+
+Iteration is ordered by raw bytes, [start, end) with None = unbounded, same
+contract as the reference's Iterator.
+"""
+
+from __future__ import annotations
+
+import bisect
+import os
+import sqlite3
+import threading
+from abc import ABC, abstractmethod
+from typing import Dict, Iterator, List, Optional, Tuple
+
+
+class DB(ABC):
+    @abstractmethod
+    def get(self, key: bytes) -> Optional[bytes]: ...
+
+    @abstractmethod
+    def set(self, key: bytes, value: bytes) -> None: ...
+
+    @abstractmethod
+    def delete(self, key: bytes) -> None: ...
+
+    @abstractmethod
+    def iterator(
+        self, start: Optional[bytes] = None, end: Optional[bytes] = None,
+        reverse: bool = False,
+    ) -> Iterator[Tuple[bytes, bytes]]: ...
+
+    def has(self, key: bytes) -> bool:
+        return self.get(key) is not None
+
+    def set_sync(self, key: bytes, value: bytes) -> None:
+        self.set(key, value)
+
+    def delete_sync(self, key: bytes) -> None:
+        self.delete(key)
+
+    def close(self) -> None: ...
+
+    def batch(self) -> "Batch":
+        return Batch(self)
+
+    def stats(self) -> Dict[str, str]:
+        return {}
+
+
+class Batch:
+    """Write batch (ref types.go Batch): buffered ops applied atomically-ish."""
+
+    def __init__(self, db: DB):
+        self._db = db
+        self._ops: List[Tuple[str, bytes, Optional[bytes]]] = []
+
+    def set(self, key: bytes, value: bytes) -> "Batch":
+        self._ops.append(("set", bytes(key), bytes(value)))
+        return self
+
+    def delete(self, key: bytes) -> "Batch":
+        self._ops.append(("del", bytes(key), None))
+        return self
+
+    def write(self) -> None:
+        if hasattr(self._db, "apply_batch"):
+            self._db.apply_batch(self._ops)
+        else:
+            for op, k, v in self._ops:
+                if op == "set":
+                    self._db.set(k, v)
+                else:
+                    self._db.delete(k)
+        self._ops.clear()
+
+    def write_sync(self) -> None:
+        self.write()
+
+
+class MemDB(DB):
+    def __init__(self):
+        self._data: Dict[bytes, bytes] = {}
+        self._keys: List[bytes] = []  # sorted
+        self._mtx = threading.RLock()
+
+    def get(self, key: bytes) -> Optional[bytes]:
+        with self._mtx:
+            return self._data.get(bytes(key))
+
+    def set(self, key: bytes, value: bytes) -> None:
+        key, value = bytes(key), bytes(value)
+        with self._mtx:
+            if key not in self._data:
+                bisect.insort(self._keys, key)
+            self._data[key] = value
+
+    def delete(self, key: bytes) -> None:
+        key = bytes(key)
+        with self._mtx:
+            if key in self._data:
+                del self._data[key]
+                i = bisect.bisect_left(self._keys, key)
+                self._keys.pop(i)
+
+    def iterator(self, start=None, end=None, reverse=False):
+        with self._mtx:
+            lo = bisect.bisect_left(self._keys, start) if start is not None else 0
+            hi = bisect.bisect_left(self._keys, end) if end is not None else len(self._keys)
+            keys = self._keys[lo:hi]
+            items = [(k, self._data[k]) for k in keys]
+        return iter(reversed(items) if reverse else items)
+
+    def apply_batch(self, ops) -> None:
+        with self._mtx:
+            for op, k, v in ops:
+                if op == "set":
+                    self.set(k, v)
+                else:
+                    self.delete(k)
+
+
+class SQLiteDB(DB):
+    """Durable KV on sqlite3 — the framework's disk backend (role of
+    goleveldb in the reference; cgo-leveldb equivalent would be the C++
+    native extension)."""
+
+    def __init__(self, name: str, dir: str = "."):
+        os.makedirs(dir, exist_ok=True)
+        self.path = os.path.join(dir, name + ".db")
+        self._conn = sqlite3.connect(self.path, check_same_thread=False)
+        self._conn.execute(
+            "CREATE TABLE IF NOT EXISTS kv (k BLOB PRIMARY KEY, v BLOB NOT NULL)"
+        )
+        self._conn.execute("PRAGMA journal_mode=WAL")
+        self._conn.execute("PRAGMA synchronous=NORMAL")
+        self._conn.commit()
+        self._mtx = threading.RLock()
+
+    def get(self, key: bytes) -> Optional[bytes]:
+        with self._mtx:
+            row = self._conn.execute(
+                "SELECT v FROM kv WHERE k = ?", (bytes(key),)
+            ).fetchone()
+        return row[0] if row else None
+
+    def set(self, key: bytes, value: bytes) -> None:
+        with self._mtx:
+            self._conn.execute(
+                "INSERT OR REPLACE INTO kv (k, v) VALUES (?, ?)",
+                (bytes(key), bytes(value)),
+            )
+            self._conn.commit()
+
+    def set_sync(self, key: bytes, value: bytes) -> None:
+        with self._mtx:
+            self._conn.execute("PRAGMA synchronous=FULL")
+            try:
+                self.set(key, value)
+            finally:
+                self._conn.execute("PRAGMA synchronous=NORMAL")
+
+    def delete(self, key: bytes) -> None:
+        with self._mtx:
+            self._conn.execute("DELETE FROM kv WHERE k = ?", (bytes(key),))
+            self._conn.commit()
+
+    def iterator(self, start=None, end=None, reverse=False):
+        q = "SELECT k, v FROM kv"
+        cond, args = [], []
+        if start is not None:
+            cond.append("k >= ?")
+            args.append(bytes(start))
+        if end is not None:
+            cond.append("k < ?")
+            args.append(bytes(end))
+        if cond:
+            q += " WHERE " + " AND ".join(cond)
+        q += " ORDER BY k" + (" DESC" if reverse else "")
+        with self._mtx:
+            rows = self._conn.execute(q, args).fetchall()
+        return iter([(bytes(k), bytes(v)) for k, v in rows])
+
+    def apply_batch(self, ops) -> None:
+        with self._mtx:
+            for op, k, v in ops:
+                if op == "set":
+                    self._conn.execute(
+                        "INSERT OR REPLACE INTO kv (k, v) VALUES (?, ?)", (k, v)
+                    )
+                else:
+                    self._conn.execute("DELETE FROM kv WHERE k = ?", (k,))
+            self._conn.commit()
+
+    def close(self) -> None:
+        with self._mtx:
+            self._conn.close()
+
+    def stats(self) -> Dict[str, str]:
+        with self._mtx:
+            n = self._conn.execute("SELECT COUNT(*) FROM kv").fetchone()[0]
+        return {"keys": str(n), "path": self.path}
+
+
+class PrefixDB(DB):
+    """View of db where every key is namespaced by prefix (ref prefix_db.go)."""
+
+    def __init__(self, db: DB, prefix: bytes):
+        self._db = db
+        self._prefix = bytes(prefix)
+
+    def _k(self, key: bytes) -> bytes:
+        return self._prefix + bytes(key)
+
+    def get(self, key: bytes) -> Optional[bytes]:
+        return self._db.get(self._k(key))
+
+    def set(self, key: bytes, value: bytes) -> None:
+        self._db.set(self._k(key), value)
+
+    def set_sync(self, key: bytes, value: bytes) -> None:
+        self._db.set_sync(self._k(key), value)
+
+    def delete(self, key: bytes) -> None:
+        self._db.delete(self._k(key))
+
+    def iterator(self, start=None, end=None, reverse=False):
+        p = self._prefix
+        s = p + start if start is not None else p
+        if end is not None:
+            e = p + end
+        else:
+            # end of prefix range: increment last byte that can be incremented
+            e = None
+            pe = bytearray(p)
+            for i in reversed(range(len(pe))):
+                if pe[i] != 0xFF:
+                    pe[i] += 1
+                    e = bytes(pe[: i + 1])
+                    break
+        return (
+            (k[len(p):], v) for k, v in self._db.iterator(s, e, reverse)
+        )
+
+
+_BACKENDS = {
+    "memdb": lambda name, dir: MemDB(),
+    "sqlite": SQLiteDB,
+    "goleveldb": SQLiteDB,  # config-compat alias for the reference's default
+}
+
+
+def new_db(name: str, backend: str = "sqlite", dir: str = ".") -> DB:
+    try:
+        factory = _BACKENDS[backend]
+    except KeyError:
+        raise ValueError(f"unknown db backend {backend!r}") from None
+    return factory(name, dir)

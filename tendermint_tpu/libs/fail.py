@@ -1,0 +1,49 @@
+"""Env-var-indexed crash injection (ref: libs/fail/fail.go).
+
+Sprinkle fail_point() at crash-consistency-critical sites (finalizeCommit /
+ApplyBlock); run the process with FAIL_TEST_INDEX=k to kill it at the k-th
+call — the persistence test suite (test/persist/test_failure_indices.sh
+pattern) iterates k and asserts recovery.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+
+_mtx = threading.Lock()
+_call_index = -1
+_fail_index = None
+_initialized = False
+
+
+def _init() -> None:
+    global _fail_index, _initialized
+    v = os.environ.get("FAIL_TEST_INDEX")
+    _fail_index = int(v) if v is not None else None
+    _initialized = True
+
+
+def reset(index=None) -> None:
+    """Test hook: reprogram the kill index and reset the counter."""
+    global _call_index, _fail_index, _initialized
+    with _mtx:
+        _call_index = -1
+        _fail_index = index
+        _initialized = True
+
+
+def fail_point() -> None:
+    """Kill the process (exit 1) if this is the FAIL_TEST_INDEX-th call."""
+    global _call_index
+    with _mtx:
+        if not _initialized:
+            _init()
+        if _fail_index is None:
+            return
+        _call_index += 1
+        if _call_index == _fail_index:
+            sys.stderr.write(f"fail_point: exiting at index {_call_index}\n")
+            sys.stderr.flush()
+            os._exit(1)

@@ -267,7 +267,9 @@ _kernel_cache = {}
 
 
 def _compiled_kernel(batch: int, mesh=None):
-    key = (batch, id(mesh) if mesh is not None else None)
+    # Mesh hashes by devices+axis_names — safe cache key (id() could be reused
+    # by a new Mesh after gc and serve a stale sharding)
+    key = (batch, mesh)
     fn = _kernel_cache.get(key)
     if fn is None:
         if mesh is not None:
@@ -330,27 +332,22 @@ def _bucket(n: int) -> int:
     return ((n + 4095) // 4096) * 4096
 
 
-def verify_batch(
-    pubs: np.ndarray,
-    msgs: Sequence[bytes],
-    sigs: np.ndarray,
-    mesh=None,
-) -> np.ndarray:
-    """Batched Go-exact ed25519 verify.
-
-    pubs (N, 32) uint8, msgs list of N byte strings, sigs (N, 64) uint8.
-    Returns (N,) bool.  One device dispatch per call (padded to a size bucket
-    to bound recompiles).
+def host_prologue(
+    pubs: np.ndarray, msgs: Sequence[bytes], sigs: np.ndarray
+) -> Tuple[np.ndarray, ...]:
+    """Flat host-side packing shared by verify_batch and the commit-window
+    packer: decompress+negate pubkeys (cached), SHA-512 h mod L, bit-pack
+    scalars, raw-limb R.  Returns
+    (neg_ax, ay, s_words, h_words, r_limbs, r_sign, valid) with batch leading.
     """
     pubs = np.ascontiguousarray(pubs, dtype=np.uint8)
     sigs = np.ascontiguousarray(sigs, dtype=np.uint8)
     n = pubs.shape[0]
-    if n == 0:
-        return np.zeros((0,), dtype=bool)
 
     valid = np.ones((n,), dtype=bool)
     # s range check: reject if top 3 bits set (Go checks only sig[63]&224)
-    valid &= (sigs[:, 63] & 224) == 0
+    if n:
+        valid &= (sigs[:, 63] & 224) == 0
 
     neg_ax = np.zeros((n, NLIMB), dtype=np.uint32)
     ay = np.zeros((n, NLIMB), dtype=np.uint32)
@@ -382,6 +379,27 @@ def verify_batch(
     h_words[~valid] = 0
     r_limbs = _bytes_to_raw_limbs(np.ascontiguousarray(sigs[:, :32]))
     r_sign = (sigs[:, 31] >> 7).astype(np.uint32)
+    return neg_ax, ay, s_words, h_words, r_limbs, r_sign, valid
+
+
+def verify_batch(
+    pubs: np.ndarray,
+    msgs: Sequence[bytes],
+    sigs: np.ndarray,
+    mesh=None,
+) -> np.ndarray:
+    """Batched Go-exact ed25519 verify.
+
+    pubs (N, 32) uint8, msgs list of N byte strings, sigs (N, 64) uint8.
+    Returns (N,) bool.  One device dispatch per call (padded to a size bucket
+    to bound recompiles).
+    """
+    n = len(pubs)
+    if n == 0:
+        return np.zeros((0,), dtype=bool)
+    neg_ax, ay, s_words, h_words, r_limbs, r_sign, valid = host_prologue(
+        pubs, msgs, sigs
+    )
 
     b = _bucket(n)
     if mesh is not None:

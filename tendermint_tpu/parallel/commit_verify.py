@@ -20,7 +20,6 @@ nil votes are a ``present`` mask so the quorum math stays branch-free.
 
 from __future__ import annotations
 
-import hashlib
 from dataclasses import dataclass
 from typing import Optional, Sequence, Tuple
 
@@ -29,7 +28,6 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from tendermint_tpu.crypto import ed25519 as _ed
 from tendermint_tpu.ops import ed25519_verify as _k
 
 SigTuple = Tuple[bytes, bytes, bytes]  # (pubkey32, msg, sig64)
@@ -71,35 +69,38 @@ def pack_commit_window(
         present=z((H, V), bool),
         power=z((H, V), np.int64),
     )
+    # flatten present votes and run the shared host prologue once
+    coords, pubs_l, msgs_l, sigs_l = [], [], [], []
     for h, row in enumerate(votes):
         for v, item in enumerate(row):
             if item is None:
                 continue
             pub, msg, sig = item
-            if len(sig) != 64 or (sig[63] & 224) != 0:
+            if len(sig) != 64:
                 continue
-            dec = _k._decompress_neg_cached(bytes(pub))
-            if dec is None:
-                continue
-            win.neg_ax[h, v] = dec[0]
-            win.ay[h, v] = dec[1]
-            hh = (
-                int.from_bytes(
-                    hashlib.sha512(sig[:32] + bytes(pub) + bytes(msg)).digest(),
-                    "little",
-                )
-                % _ed.L
-            )
-            win.s_words[h, v] = np.frombuffer(sig[32:], np.uint8).view("<u4")
-            win.h_words[h, v] = np.frombuffer(
-                hh.to_bytes(32, "little"), np.uint8
-            ).view("<u4")
-            win.r_limbs[h, v] = _k._bytes_to_raw_limbs(
-                np.frombuffer(sig[:32], np.uint8)[None]
-            )[0]
-            win.r_sign[h, v] = sig[31] >> 7
-            win.present[h, v] = True
-            win.power[h, v] = powers[h][v]
+            coords.append((h, v))
+            pubs_l.append(bytes(pub))
+            msgs_l.append(bytes(msg))
+            sigs_l.append(bytes(sig))
+    if coords:
+        n = len(coords)
+        pubs = np.frombuffer(b"".join(pubs_l), np.uint8).reshape(n, 32)
+        sigs = np.frombuffer(b"".join(sigs_l), np.uint8).reshape(n, 64)
+        neg_ax, ay, s_words, h_words, r_limbs, r_sign, valid = _k.host_prologue(
+            pubs, msgs_l, sigs
+        )
+        hs = np.array([c[0] for c in coords])
+        vs = np.array([c[1] for c in coords])
+        win.neg_ax[hs, vs] = neg_ax
+        win.ay[hs, vs] = ay
+        win.s_words[hs, vs] = s_words
+        win.h_words[hs, vs] = h_words
+        win.r_limbs[hs, vs] = r_limbs
+        win.r_sign[hs, vs] = r_sign
+        win.present[hs, vs] = valid
+        for j, (h, v) in enumerate(coords):
+            if valid[j]:
+                win.power[h, v] = powers[h][v]
     return win
 
 
@@ -117,7 +118,7 @@ _step_cache = {}
 
 
 def _compiled_step(mesh):
-    key = id(mesh) if mesh is not None else None
+    key = mesh  # Mesh hashes by devices+axis_names; id() could be gc-reused
     fn = _step_cache.get(key)
     if fn is not None:
         return fn
@@ -168,12 +169,17 @@ def verify_commit_window(
             "power",
         )
     ]
-    if mesh is not None:
-        from jax.sharding import NamedSharding, PartitionSpec as PS
+    # Voting powers are int64 (reference clips at 2^60); without x64, jit
+    # silently canonicalizes them to int32 and the quorum tally wraps — a
+    # consensus-safety bug.  Scope the flag to this dispatch instead of
+    # flipping global dtype semantics for the whole process at import time.
+    with jax.enable_x64(True):
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as PS
 
-        hv = NamedSharding(mesh, PS(*mesh.axis_names[:2]))
-        arrs = [jax.device_put(a, hv) for a in arrs]
-    ok, tally, committed = _compiled_step(mesh)(*arrs, np.int64(total_power))
+            hv = NamedSharding(mesh, PS(*mesh.axis_names[:2]))
+            arrs = [jax.device_put(a, hv) for a in arrs]
+        ok, tally, committed = _compiled_step(mesh)(*arrs, np.int64(total_power))
     return (
         np.asarray(ok)[:H, :V],
         np.asarray(tally)[:H],

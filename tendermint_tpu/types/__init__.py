@@ -1,0 +1,49 @@
+"""Domain types — the shared vocabulary of the framework (ref: types/).
+
+Depends only on crypto/, encoding/, libs/; imported by everything above
+(SURVEY.md §1 layer map)."""
+
+from tendermint_tpu.types.block import (
+    Block,
+    Commit,
+    Data,
+    EvidenceData,
+    Header,
+    Version,
+)
+from tendermint_tpu.types.core import (
+    BlockID,
+    PartSetHeader,
+    SignedMsgType,
+    canonical_proposal_sign_bytes,
+    canonical_vote_sign_bytes,
+    is_vote_type_valid,
+)
+from tendermint_tpu.types.evidence import DuplicateVoteEvidence, Evidence, EvidenceError
+from tendermint_tpu.types.genesis import GenesisDoc, GenesisValidator
+from tendermint_tpu.types.params import (
+    BLOCK_PART_SIZE_BYTES,
+    MAX_BLOCK_SIZE_BYTES,
+    BlockSizeParams,
+    ConsensusParams,
+    EvidenceParams,
+    ValidatorParams,
+)
+from tendermint_tpu.types.part_set import Part, PartSet
+from tendermint_tpu.types.priv_validator import MockPV, PrivValidator
+from tendermint_tpu.types.proposal import Heartbeat, Proposal
+from tendermint_tpu.types.results import ABCIResult, ABCIResults
+from tendermint_tpu.types.tx import Tx, TxProof, Txs
+from tendermint_tpu.types.validator_set import (
+    CommitError,
+    TooMuchChangeError,
+    Validator,
+    ValidatorSet,
+)
+from tendermint_tpu.types.vote import (
+    ErrVoteConflictingVotes,
+    ErrVoteInvalidSignature,
+    Vote,
+    VoteError,
+)
+from tendermint_tpu.types.vote_set import VoteSet

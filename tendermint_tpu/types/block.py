@@ -1,0 +1,357 @@
+"""Block, Header, Data, Commit (ref: types/block.go).
+
+Header.hash() is a merkle root over the encoded fields in declaration order
+(block.go:391-407); Commit.hash() a root over encoded precommits.  All hashes
+use this framework's deterministic codec (not amino) — cross-implementation
+wire compatibility is a non-goal, determinism within the network is the
+requirement.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+from typing import List, Optional, Sequence
+
+from tendermint_tpu.crypto import merkle
+from tendermint_tpu.encoding.codec import Reader, Writer, encode_bytes
+from tendermint_tpu.libs.bit_array import BitArray
+from tendermint_tpu.types.core import BlockID, PartSetHeader, SignedMsgType
+from tendermint_tpu.types.evidence import DuplicateVoteEvidence, evidence_hash
+from tendermint_tpu.types.tx import Tx, Txs
+from tendermint_tpu.types.vote import Vote
+
+MAX_HEADER_BYTES = 653
+
+
+@dataclass(frozen=True)
+class Version:
+    """Consensus version (block protocol, app version)."""
+
+    block: int = 10
+    app: int = 0
+
+    def encode(self, w: Writer) -> None:
+        w.uvarint(self.block).uvarint(self.app)
+
+    @classmethod
+    def decode(cls, r: Reader) -> "Version":
+        return cls(block=r.uvarint(), app=r.uvarint())
+
+
+@dataclass
+class Header:
+    # basic block info
+    version: Version = field(default_factory=Version)
+    chain_id: str = ""
+    height: int = 0
+    time_ns: int = 0
+    num_txs: int = 0
+    total_txs: int = 0
+    # prev block info
+    last_block_id: BlockID = field(default_factory=BlockID)
+    # hashes of block data
+    last_commit_hash: bytes = b""
+    data_hash: bytes = b""
+    # hashes from the app output from the prev block
+    validators_hash: bytes = b""
+    next_validators_hash: bytes = b""
+    consensus_hash: bytes = b""
+    app_hash: bytes = b""
+    last_results_hash: bytes = b""
+    # consensus info
+    evidence_hash: bytes = b""
+    proposer_address: bytes = b""
+
+    def hash(self) -> Optional[bytes]:
+        """Merkle root of the encoded fields, order as declared
+        (block.go:391).  None until ValidatorsHash is populated."""
+        if not self.validators_hash:
+            return None
+        vw = Writer()
+        self.version.encode(vw)
+        lbw = Writer()
+        self.last_block_id.encode(lbw)
+        fields = [
+            vw.build(),
+            self.chain_id.encode(),
+            self.height.to_bytes(8, "big", signed=True),
+            self.time_ns.to_bytes(8, "big", signed=True),
+            self.num_txs.to_bytes(8, "big", signed=True),
+            self.total_txs.to_bytes(8, "big", signed=True),
+            lbw.build(),
+            self.last_commit_hash,
+            self.data_hash,
+            self.validators_hash,
+            self.next_validators_hash,
+            self.consensus_hash,
+            self.app_hash,
+            self.last_results_hash,
+            self.evidence_hash,
+            self.proposer_address,
+        ]
+        return merkle.hash_from_byte_slices(fields)
+
+    def encode(self, w: Writer) -> None:
+        self.version.encode(w)
+        w.string(self.chain_id).svarint(self.height).fixed64(self.time_ns)
+        w.svarint(self.num_txs).svarint(self.total_txs)
+        self.last_block_id.encode(w)
+        for b in (
+            self.last_commit_hash,
+            self.data_hash,
+            self.validators_hash,
+            self.next_validators_hash,
+            self.consensus_hash,
+            self.app_hash,
+            self.last_results_hash,
+            self.evidence_hash,
+            self.proposer_address,
+        ):
+            w.bytes(b)
+
+    @classmethod
+    def decode(cls, r: Reader) -> "Header":
+        return cls(
+            version=Version.decode(r),
+            chain_id=r.string(),
+            height=r.svarint(),
+            time_ns=r.fixed64(),
+            num_txs=r.svarint(),
+            total_txs=r.svarint(),
+            last_block_id=BlockID.decode(r),
+            last_commit_hash=r.bytes(),
+            data_hash=r.bytes(),
+            validators_hash=r.bytes(),
+            next_validators_hash=r.bytes(),
+            consensus_hash=r.bytes(),
+            app_hash=r.bytes(),
+            last_results_hash=r.bytes(),
+            evidence_hash=r.bytes(),
+            proposer_address=r.bytes(),
+        )
+
+
+@dataclass
+class Commit:
+    """+2/3 precommits for a block; precommits[i] indexes the validator set
+    (nil allowed).  Never empty except height 1 (block.go:458)."""
+
+    block_id: BlockID = field(default_factory=BlockID)
+    precommits: List[Optional[Vote]] = field(default_factory=list)
+
+    # memo only — excluded from equality/repr so hashed and unhashed commits
+    # with identical contents still compare equal
+    _hash: Optional[bytes] = field(default=None, compare=False, repr=False)
+
+    def _first(self) -> Optional[Vote]:
+        for pc in self.precommits:
+            if pc is not None:
+                return pc
+        return None
+
+    def height(self) -> int:
+        v = self._first()
+        return v.height if v else 0
+
+    def round(self) -> int:
+        v = self._first()
+        return v.round if v else 0
+
+    def size(self) -> int:
+        return len(self.precommits)
+
+    def is_commit(self) -> bool:
+        return len(self.precommits) != 0
+
+    def bit_array(self) -> BitArray:
+        ba = BitArray(len(self.precommits))
+        for i, pc in enumerate(self.precommits):
+            ba.set_index(i, pc is not None)
+        return ba
+
+    def hash(self) -> bytes:
+        if self._hash is None:
+            bs = []
+            for pc in self.precommits:
+                bs.append(pc.marshal() if pc is not None else b"")
+            self._hash = merkle.hash_from_byte_slices(bs)
+        return self._hash
+
+    def validate_basic(self) -> None:
+        if self.block_id.is_zero():
+            raise ValueError("commit cannot be for nil block")
+        if not self.precommits:
+            raise ValueError("no precommits in commit")
+        height, round = self.height(), self.round()
+        for pc in self.precommits:
+            if pc is None:
+                continue
+            if pc.vote_type != SignedMsgType.PRECOMMIT:
+                raise ValueError("commit vote is not precommit")
+            if pc.height != height or pc.round != round:
+                raise ValueError("commit precommit H/R mismatch")
+
+    def encode(self, w: Writer) -> None:
+        self.block_id.encode(w)
+        w.uvarint(len(self.precommits))
+        for pc in self.precommits:
+            if pc is None:
+                w.bool(False)
+            else:
+                w.bool(True)
+                pc.encode(w)
+
+    def marshal(self) -> bytes:
+        w = Writer()
+        self.encode(w)
+        return w.build()
+
+    @classmethod
+    def decode(cls, r: Reader) -> "Commit":
+        block_id = BlockID.decode(r)
+        n = r.uvarint()
+        pcs: List[Optional[Vote]] = []
+        for _ in range(n):
+            pcs.append(Vote.decode(r) if r.bool() else None)
+        return cls(block_id=block_id, precommits=pcs)
+
+    @classmethod
+    def unmarshal(cls, data: bytes) -> "Commit":
+        return cls.decode(Reader(data))
+
+
+@dataclass
+class Data:
+    txs: Txs = field(default_factory=Txs)
+
+    def hash(self) -> bytes:
+        return self.txs.hash()
+
+    def encode(self, w: Writer) -> None:
+        w.uvarint(len(self.txs))
+        for tx in self.txs:
+            w.bytes(bytes(tx))
+
+    @classmethod
+    def decode(cls, r: Reader) -> "Data":
+        n = r.uvarint()
+        return cls(txs=Txs([Tx(r.bytes()) for _ in range(n)]))
+
+
+@dataclass
+class EvidenceData:
+    evidence: List[DuplicateVoteEvidence] = field(default_factory=list)
+
+    def hash(self) -> bytes:
+        return evidence_hash(self.evidence)
+
+    def encode(self, w: Writer) -> None:
+        w.uvarint(len(self.evidence))
+        for ev in self.evidence:
+            ev.encode(w)
+
+    @classmethod
+    def decode(cls, r: Reader) -> "EvidenceData":
+        n = r.uvarint()
+        return cls(evidence=[DuplicateVoteEvidence.decode(r) for _ in range(n)])
+
+
+class Block:
+    def __init__(
+        self,
+        header: Header,
+        data: Data,
+        evidence: EvidenceData,
+        last_commit: Commit,
+    ):
+        self.header = header
+        self.data = data
+        self.evidence = evidence
+        self.last_commit = last_commit
+        self._block_id_hash: Optional[bytes] = None
+
+    @classmethod
+    def make_block(
+        cls, height: int, txs: Sequence[bytes], last_commit: Commit,
+        evidence: Optional[List[DuplicateVoteEvidence]] = None,
+    ) -> "Block":
+        """MakeBlock (block.go:35): header partially filled; caller populates
+        state-derived fields via fill_header/populate."""
+        block = cls(
+            header=Header(height=height, num_txs=len(txs)),
+            data=Data(txs=Txs([Tx(t) for t in txs])),
+            evidence=EvidenceData(evidence=list(evidence or [])),
+            last_commit=last_commit,
+        )
+        block.fill_header()
+        return block
+
+    def fill_header(self) -> None:
+        if not self.header.last_commit_hash:
+            self.header.last_commit_hash = self.last_commit.hash()
+        if not self.header.data_hash:
+            self.header.data_hash = self.data.hash()
+        if not self.header.evidence_hash:
+            self.header.evidence_hash = self.evidence.hash()
+
+    @property
+    def height(self) -> int:
+        return self.header.height
+
+    def hash(self) -> Optional[bytes]:
+        self.fill_header()
+        return self.header.hash()
+
+    def make_part_set(self, part_size: Optional[int] = None):
+        from tendermint_tpu.types.part_set import BLOCK_PART_SIZE_BYTES, PartSet
+
+        return PartSet.from_data(self.marshal(), part_size or BLOCK_PART_SIZE_BYTES)
+
+    def hashes_to(self, hash_: bytes) -> bool:
+        h = self.hash()
+        return bool(hash_) and h == hash_
+
+    def validate_basic(self) -> None:
+        if self.header.height < 0:
+            raise ValueError("negative header height")
+        if self.header.height > 1:
+            if not self.last_commit.is_commit():
+                raise ValueError("nil LastCommit for height > 1")
+            self.last_commit.validate_basic()
+        if self.header.last_commit_hash != self.last_commit.hash():
+            raise ValueError("wrong LastCommitHash")
+        if self.header.num_txs != len(self.data.txs):
+            raise ValueError("wrong NumTxs")
+        if self.header.data_hash != self.data.hash():
+            raise ValueError("wrong DataHash")
+        if self.header.evidence_hash != self.evidence.hash():
+            raise ValueError("wrong EvidenceHash")
+
+    # codec ----------------------------------------------------------------
+    def encode(self, w: Writer) -> None:
+        self.header.encode(w)
+        self.data.encode(w)
+        self.evidence.encode(w)
+        self.last_commit.encode(w)
+
+    def marshal(self) -> bytes:
+        w = Writer()
+        self.encode(w)
+        return w.build()
+
+    @classmethod
+    def decode(cls, r: Reader) -> "Block":
+        return cls(
+            header=Header.decode(r),
+            data=Data.decode(r),
+            evidence=EvidenceData.decode(r),
+            last_commit=Commit.decode(r),
+        )
+
+    @classmethod
+    def unmarshal(cls, data: bytes) -> "Block":
+        return cls.decode(Reader(data))
+
+    def __str__(self) -> str:
+        h = self.hash()
+        return f"Block{{H:{self.header.height} {h.hex()[:12] if h else '-'}}}"

@@ -1,0 +1,86 @@
+"""Evidence of validator misbehavior (ref: types/evidence.go).
+
+Only DuplicateVoteEvidence exists in the reference protocol: two signed votes
+from one validator for the same height/round/type but different blocks.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass
+from typing import List, Optional
+
+from tendermint_tpu.crypto import merkle
+from tendermint_tpu.crypto.hashing import tmhash
+from tendermint_tpu.crypto.keys import PubKey, pubkey_from_json_obj
+from tendermint_tpu.encoding.codec import Reader, Writer
+from tendermint_tpu.types.vote import Vote
+
+
+class EvidenceError(Exception):
+    pass
+
+
+@dataclass(frozen=True)
+class DuplicateVoteEvidence:
+    pub_key: PubKey
+    vote_a: Vote
+    vote_b: Vote
+
+    @property
+    def height(self) -> int:
+        return self.vote_a.height
+
+    @property
+    def address(self) -> bytes:
+        return self.pub_key.address()
+
+    def hash(self) -> bytes:
+        return tmhash(self.marshal())
+
+    def verify(self, chain_id: str) -> None:
+        """Raise unless this is genuine double-signing (evidence.go Verify):
+        same H/R/type, different block, both sigs valid for pub_key."""
+        a, b = self.vote_a, self.vote_b
+        if a.height != b.height or a.round != b.round or a.vote_type != b.vote_type:
+            raise EvidenceError("votes are not from the same H/R/S")
+        if a.block_id == b.block_id:
+            raise EvidenceError("votes are for the same block")
+        if a.validator_address != b.validator_address:
+            raise EvidenceError("votes are from different validators")
+        if a.validator_address != self.pub_key.address():
+            raise EvidenceError("address does not match pubkey")
+        a.verify(chain_id, self.pub_key)
+        b.verify(chain_id, self.pub_key)
+
+    def equal(self, other: "DuplicateVoteEvidence") -> bool:
+        return self.marshal() == other.marshal()
+
+    def encode(self, w: Writer) -> None:
+        w.string(json.dumps(self.pub_key.to_json_obj(), sort_keys=True))
+        self.vote_a.encode(w)
+        self.vote_b.encode(w)
+
+    def marshal(self) -> bytes:
+        w = Writer()
+        self.encode(w)
+        return w.build()
+
+    @classmethod
+    def decode(cls, r: Reader) -> "DuplicateVoteEvidence":
+        return cls(
+            pub_key=pubkey_from_json_obj(json.loads(r.string())),
+            vote_a=Vote.decode(r),
+            vote_b=Vote.decode(r),
+        )
+
+    @classmethod
+    def unmarshal(cls, data: bytes) -> "DuplicateVoteEvidence":
+        return cls.decode(Reader(data))
+
+
+Evidence = DuplicateVoteEvidence  # the only concrete kind in the protocol
+
+
+def evidence_hash(evidence: List[DuplicateVoteEvidence]) -> bytes:
+    return merkle.hash_from_byte_slices([e.marshal() for e in evidence])

@@ -1,0 +1,58 @@
+"""PrivValidator interface + MockPV test signer (ref: types/priv_validator.go).
+
+The production FilePV (disk-backed, double-sign protected) lives in
+tendermint_tpu/privval; MockPV signs anything and is the consensus-test
+workhorse (priv_validator.go:47)."""
+
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+from typing import Optional
+
+from tendermint_tpu.crypto.keys import PrivKey, PrivKeyEd25519, PubKey
+from tendermint_tpu.types.proposal import Heartbeat, Proposal
+from tendermint_tpu.types.vote import Vote
+
+
+class PrivValidator(ABC):
+    """Signs votes/proposals with one consistent key."""
+
+    @abstractmethod
+    def get_pub_key(self) -> PubKey: ...
+
+    @property
+    def address(self) -> bytes:
+        return self.get_pub_key().address()
+
+    @abstractmethod
+    def sign_vote(self, chain_id: str, vote: Vote) -> Vote: ...
+
+    @abstractmethod
+    def sign_proposal(self, chain_id: str, proposal: Proposal) -> Proposal: ...
+
+    def sign_heartbeat(self, chain_id: str, heartbeat: Heartbeat) -> Heartbeat:
+        raise NotImplementedError
+
+
+class MockPV(PrivValidator):
+    """Implements PrivValidator without persistence or double-sign checks."""
+
+    def __init__(self, priv_key: Optional[PrivKey] = None):
+        self._priv = priv_key or PrivKeyEd25519.generate()
+        self.disable_checks = False  # byzantine-test hook (MockPV.DisableChecks)
+
+    def get_pub_key(self) -> PubKey:
+        return self._priv.pub_key()
+
+    def sign_vote(self, chain_id: str, vote: Vote) -> Vote:
+        return vote.with_signature(self._priv.sign(vote.sign_bytes(chain_id)))
+
+    def sign_proposal(self, chain_id: str, proposal: Proposal) -> Proposal:
+        return proposal.with_signature(
+            self._priv.sign(proposal.sign_bytes(chain_id))
+        )
+
+    def sign_heartbeat(self, chain_id: str, heartbeat: Heartbeat) -> Heartbeat:
+        return heartbeat.with_signature(
+            self._priv.sign(heartbeat.sign_bytes(chain_id))
+        )

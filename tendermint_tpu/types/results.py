@@ -1,0 +1,30 @@
+"""ABCIResults — deterministic digest of DeliverTx results, rooted into
+Header.LastResultsHash (ref: types/results.go)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Sequence
+
+from tendermint_tpu.crypto import merkle
+from tendermint_tpu.encoding.codec import Writer
+
+
+@dataclass(frozen=True)
+class ABCIResult:
+    code: int
+    data: bytes
+
+    def bytes_(self) -> bytes:
+        w = Writer()
+        w.uvarint(self.code).bytes(self.data)
+        return w.build()
+
+
+class ABCIResults(list):
+    @classmethod
+    def from_deliver_txs(cls, responses: Sequence) -> "ABCIResults":
+        return cls(ABCIResult(code=r.code, data=r.data or b"") for r in responses)
+
+    def hash(self) -> bytes:
+        return merkle.hash_from_byte_slices([r.bytes_() for r in self])

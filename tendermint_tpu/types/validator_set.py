@@ -1,0 +1,345 @@
+"""Validator + ValidatorSet — proposer rotation and commit verification
+(ref: types/validator.go, types/validator_set.go).
+
+VerifyCommit is THE signature hot spot of the whole system
+(validator_set.go:273-298 serial loop).  Here it collects every non-nil
+precommit of the commit and dispatches ONE BatchVerifier call — device-batched
+for ed25519 — then tallies voting power.  Error semantics match the reference:
+any invalid signature fails the whole commit; nil precommits are fine; stray
+precommits for other blocks count for availability but not power.
+"""
+
+from __future__ import annotations
+
+import heapq
+from dataclasses import dataclass, field, replace
+from typing import Callable, Iterable, List, Optional, Sequence, Tuple
+
+from tendermint_tpu.crypto import merkle
+from tendermint_tpu.crypto.batch import verify_generic
+from tendermint_tpu.crypto.keys import PubKey, pubkey_from_json_obj
+from tendermint_tpu.encoding.codec import Reader, Writer
+from tendermint_tpu.types.core import BlockID, SignedMsgType
+from tendermint_tpu.types.vote import Vote
+
+_MAX_TOTAL_POWER = 1 << 60  # clip bound (reference uses int64 overflow clips)
+
+
+def _clip(v: int) -> int:
+    return max(-_MAX_TOTAL_POWER, min(_MAX_TOTAL_POWER, v))
+
+
+@dataclass
+class Validator:
+    pub_key: PubKey
+    voting_power: int
+    accum: int = 0
+
+    @property
+    def address(self) -> bytes:
+        return self.pub_key.address()
+
+    def copy(self) -> "Validator":
+        return Validator(self.pub_key, self.voting_power, self.accum)
+
+    def compare_accum(self, other: "Validator") -> "Validator":
+        """Higher accum wins; ties break toward the lower address
+        (ref validator.go CompareAccum)."""
+        if self.accum > other.accum:
+            return self
+        if self.accum < other.accum:
+            return other
+        return self if self.address < other.address else other
+
+    def hash_bytes(self) -> bytes:
+        """Bytes folded into ValidatorsHash (ref validator.go:104 Bytes =
+        pubkey + voting power)."""
+        w = Writer()
+        w.bytes(self.pub_key.bytes()).svarint(self.voting_power)
+        return w.build()
+
+    def encode(self, w: Writer) -> None:
+        import json
+
+        w.string(json.dumps(self.pub_key.to_json_obj(), sort_keys=True))
+        w.svarint(self.voting_power).svarint(self.accum)
+
+    @classmethod
+    def decode(cls, r: Reader) -> "Validator":
+        import json
+
+        pk = pubkey_from_json_obj(json.loads(r.string()))
+        return cls(pub_key=pk, voting_power=r.svarint(), accum=r.svarint())
+
+
+class ValidatorSet:
+    """Sorted by address; proposer rotates by accumulated voting power."""
+
+    def __init__(self, validators: Optional[Sequence[Validator]] = None):
+        vals = [v.copy() for v in (validators or [])]
+        vals.sort(key=lambda v: v.address)
+        self.validators: List[Validator] = vals
+        self.proposer: Optional[Validator] = None
+        self._total_voting_power: Optional[int] = None
+        self._addresses: Optional[List[bytes]] = None  # sorted, lazy
+        if vals:
+            self.increment_accum(1)
+
+    def _addr_list(self) -> List[bytes]:
+        if self._addresses is None:
+            self._addresses = [v.address for v in self.validators]
+        return self._addresses
+
+    def _invalidate(self) -> None:
+        """Membership changed: drop every derived cache (ref invalidates
+        Proposer and totalVotingPower on Add/Update/Remove)."""
+        self.proposer = None
+        self._total_voting_power = None
+        self._addresses = None
+
+    # size / lookup --------------------------------------------------------
+    @property
+    def size(self) -> int:
+        return len(self.validators)
+
+    def is_nil_or_empty(self) -> bool:
+        return len(self.validators) == 0
+
+    def has_address(self, address: bytes) -> bool:
+        return self.get_by_address(address)[0] != -1
+
+    def get_by_address(self, address: bytes) -> Tuple[int, Optional[Validator]]:
+        """Binary search on the sorted-address invariant (ref sort.Search at
+        validator_set.go:114) — this sits on the commit-verify hot path."""
+        import bisect
+
+        addrs = self._addr_list()
+        i = bisect.bisect_left(addrs, address)
+        if i < len(addrs) and addrs[i] == address:
+            return i, self.validators[i].copy()
+        return -1, None
+
+    def get_by_index(self, index: int) -> Tuple[bytes, Optional[Validator]]:
+        if 0 <= index < len(self.validators):
+            v = self.validators[index]
+            return v.address, v.copy()
+        return b"", None
+
+    def total_voting_power(self) -> int:
+        if self._total_voting_power is None:
+            self._total_voting_power = sum(v.voting_power for v in self.validators)
+        return self._total_voting_power
+
+    # proposer rotation ----------------------------------------------------
+    def get_proposer(self) -> Optional[Validator]:
+        if not self.validators:
+            return None
+        if self.proposer is None:
+            self.proposer = self._find_proposer()
+        return self.proposer.copy()
+
+    def _find_proposer(self) -> Validator:
+        best = self.validators[0]
+        for v in self.validators[1:]:
+            best = best.compare_accum(v)
+        return best
+
+    def increment_accum(self, times: int) -> None:
+        """accum += power·times for all; then `times` rounds of: highest-accum
+        becomes proposer, minus totalPower (ref validator_set.go:65-88)."""
+        if not self.validators:
+            raise ValueError("empty validator set")
+        for v in self.validators:
+            v.accum = _clip(v.accum + _clip(v.voting_power * times))
+        for i in range(times):
+            mostest = self._find_proposer()
+            mostest.accum = _clip(mostest.accum - self.total_voting_power())
+            if i == times - 1:
+                self.proposer = mostest
+
+    def copy(self) -> "ValidatorSet":
+        new = ValidatorSet.__new__(ValidatorSet)
+        new.validators = [v.copy() for v in self.validators]
+        new.proposer = self.proposer
+        new._total_voting_power = self._total_voting_power
+        new._addresses = None
+        return new
+
+    def copy_increment_accum(self, times: int) -> "ValidatorSet":
+        c = self.copy()
+        c.increment_accum(times)
+        return c
+
+    # membership updates (driven by ABCI EndBlock) -------------------------
+    def add(self, val: Validator) -> bool:
+        """Insert keeping address order; invalidates caches
+        (ref validator_set.go:189-212)."""
+        if self.has_address(val.address):
+            return False
+        self.validators.append(val.copy())
+        self.validators.sort(key=lambda v: v.address)
+        self._invalidate()
+        return True
+
+    def update(self, val: Validator) -> bool:
+        """Wholesale replacement, accum included (ref validator_set.go:216-226:
+        `vals.Validators[index] = val.Copy()`)."""
+        idx, _ = self.get_by_address(val.address)
+        if idx == -1:
+            return False
+        self.validators[idx] = val.copy()
+        self._invalidate()
+        return True
+
+    def remove(self, address: bytes) -> Optional[Validator]:
+        idx, _ = self.get_by_address(address)
+        if idx == -1:
+            return None
+        removed = self.validators.pop(idx)
+        self._invalidate()
+        return removed
+
+    # hashing --------------------------------------------------------------
+    def hash(self) -> bytes:
+        return merkle.hash_from_byte_slices(
+            [v.hash_bytes() for v in self.validators]
+        )
+
+    # THE hot path ---------------------------------------------------------
+    def verify_commit(
+        self, chain_id: str, block_id: BlockID, height: int, commit, verifier=None
+    ) -> None:
+        """Raise unless +2/3 of this set signed blockID at height.
+
+        One BatchVerifier dispatch for all non-nil precommits (the reference
+        loops serially at validator_set.go:273-298)."""
+        if self.size != len(commit.precommits):
+            raise CommitError(
+                f"wrong set size: {self.size} vs {len(commit.precommits)}"
+            )
+        if height != commit.height():
+            raise CommitError(f"wrong height: {height} vs {commit.height()}")
+        if block_id != commit.block_id:
+            raise CommitError("wrong block id")
+
+        round = commit.round()
+        idxs, pubkeys, msgs, sigs = [], [], [], []
+        for idx, precommit in enumerate(commit.precommits):
+            if precommit is None:
+                continue
+            if precommit.height != height:
+                raise CommitError(f"precommit height {precommit.height} != {height}")
+            if precommit.round != round:
+                raise CommitError(f"precommit round {precommit.round} != {round}")
+            if precommit.vote_type != SignedMsgType.PRECOMMIT:
+                raise CommitError(f"not a precommit @ index {idx}")
+            val = self.validators[idx]
+            idxs.append(idx)
+            pubkeys.append(val.pub_key)
+            msgs.append(precommit.sign_bytes(chain_id))
+            sigs.append(precommit.signature)
+
+        ok = verify_generic(pubkeys, msgs, sigs, verifier=verifier)
+        tallied = 0
+        for j, idx in enumerate(idxs):
+            if not ok[j]:
+                raise CommitError(
+                    f"invalid signature: {commit.precommits[idx]}"
+                )
+            if block_id == commit.precommits[idx].block_id:
+                tallied += self.validators[idx].voting_power
+
+        if tallied * 3 <= self.total_voting_power() * 2:
+            raise CommitError(
+                f"insufficient voting power: got {tallied}, "
+                f"needed more than {self.total_voting_power() * 2 // 3}"
+            )
+
+    def verify_future_commit(
+        self, new_set: "ValidatorSet", chain_id: str, block_id: BlockID, height: int,
+        commit, verifier=None,
+    ) -> None:
+        """Light-client rule (validator_set.go:339): the commit must be valid
+        for the NEW set, and also signed by +2/3 of the OLD set's power."""
+        new_set.verify_commit(chain_id, block_id, height, commit, verifier=verifier)
+
+        old_voting_power = 0
+        seen = set()
+        round = commit.round()
+        idxs, pubkeys, msgs, sigs, powers = [], [], [], [], []
+        for idx, precommit in enumerate(commit.precommits):
+            if precommit is None:
+                continue
+            if precommit.height != height:
+                raise CommitError("precommit height mismatch")
+            if precommit.round != round:
+                raise CommitError("precommit round mismatch")
+            if precommit.vote_type != SignedMsgType.PRECOMMIT:
+                raise CommitError("not a precommit")
+            old_idx, val = self.get_by_address(precommit.validator_address)
+            if val is None or old_idx in seen:
+                continue
+            seen.add(old_idx)
+            pubkeys.append(val.pub_key)
+            msgs.append(precommit.sign_bytes(chain_id))
+            sigs.append(precommit.signature)
+            powers.append((val.voting_power, precommit.block_id))
+
+        ok = verify_generic(pubkeys, msgs, sigs, verifier=verifier)
+        for j in range(len(pubkeys)):
+            if not ok[j]:
+                raise CommitError("invalid signature (old set)")
+            power, pc_block_id = powers[j]
+            if block_id == pc_block_id:
+                old_voting_power += power
+
+        if old_voting_power * 3 <= self.total_voting_power() * 2:
+            raise TooMuchChangeError(
+                f"invalid commit -- insufficient old voting power: got "
+                f"{old_voting_power}"
+            )
+
+    # codec ----------------------------------------------------------------
+    def encode(self, w: Writer) -> None:
+        w.uvarint(len(self.validators))
+        for v in self.validators:
+            v.encode(w)
+        prop_idx = -1
+        if self.proposer is not None:
+            for i, v in enumerate(self.validators):
+                if v.address == self.proposer.address:
+                    prop_idx = i
+                    break
+        w.svarint(prop_idx)
+
+    def marshal(self) -> bytes:
+        w = Writer()
+        self.encode(w)
+        return w.build()
+
+    @classmethod
+    def decode(cls, r: Reader) -> "ValidatorSet":
+        n = r.uvarint()
+        vals = [Validator.decode(r) for _ in range(n)]
+        prop_idx = r.svarint()
+        vs = cls.__new__(cls)
+        vs.validators = vals
+        vs._total_voting_power = None
+        vs._addresses = None
+        vs.proposer = vals[prop_idx] if 0 <= prop_idx < len(vals) else None
+        return vs
+
+    @classmethod
+    def unmarshal(cls, data: bytes) -> "ValidatorSet":
+        return cls.decode(Reader(data))
+
+    def __iter__(self):
+        return iter(self.validators)
+
+
+class CommitError(Exception):
+    pass
+
+
+class TooMuchChangeError(CommitError):
+    """Old set signed < 2/3 of a future commit (lite client bisection trigger)."""

@@ -1,0 +1,146 @@
+"""Vote — a prevote/precommit from a validator (ref: types/vote.go).
+
+Signature verification goes through the BatchVerifier boundary
+(tendermint_tpu/crypto/batch.py) so the interactive single-vote path stays on
+host while commit/fast-sync paths batch to the device.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+from typing import Optional
+
+from tendermint_tpu.crypto.keys import PubKey
+from tendermint_tpu.encoding.codec import Reader, Writer
+from tendermint_tpu.types.core import (
+    BlockID,
+    SignedMsgType,
+    canonical_vote_sign_bytes,
+    is_vote_type_valid,
+)
+
+ADDRESS_SIZE = 20
+# max encoded vote size (reference MaxVoteBytes=223 incl. amino overhead)
+MAX_VOTE_BYTES = 223
+
+
+class VoteError(Exception):
+    pass
+
+
+class ErrVoteInvalidValidatorIndex(VoteError):
+    pass
+
+
+class ErrVoteInvalidValidatorAddress(VoteError):
+    pass
+
+
+class ErrVoteInvalidSignature(VoteError):
+    pass
+
+
+class ErrVoteNonDeterministicSignature(VoteError):
+    pass
+
+
+class ErrVoteConflictingVotes(VoteError):
+    """Same validator, same H/R/type, different blocks — evidence material."""
+
+    def __init__(self, vote_a: "Vote", vote_b: "Vote", pub_key: Optional[PubKey] = None):
+        super().__init__(f"conflicting votes from validator {vote_a.validator_address.hex()}")
+        self.vote_a = vote_a
+        self.vote_b = vote_b
+        self.pub_key = pub_key
+
+
+@dataclass(frozen=True)
+class Vote:
+    vote_type: SignedMsgType
+    height: int
+    round: int
+    timestamp_ns: int
+    block_id: BlockID
+    validator_address: bytes
+    validator_index: int
+    signature: bytes = b""
+
+    def sign_bytes(self, chain_id: str) -> bytes:
+        return canonical_vote_sign_bytes(
+            chain_id,
+            self.vote_type,
+            self.height,
+            self.round,
+            self.timestamp_ns,
+            self.block_id,
+        )
+
+    def verify(self, chain_id: str, pub_key: PubKey) -> None:
+        """Raises on mismatch (ref vote.go:102). Single-vote interactive path;
+        batched paths use sign_bytes + the BatchVerifier directly."""
+        if pub_key.address() != self.validator_address:
+            raise ErrVoteInvalidValidatorAddress()
+        if not pub_key.verify_bytes(self.sign_bytes(chain_id), self.signature):
+            raise ErrVoteInvalidSignature()
+
+    def with_signature(self, sig: bytes) -> "Vote":
+        return replace(self, signature=sig)
+
+    @property
+    def is_nil(self) -> bool:
+        return self.block_id.is_zero()
+
+    def validate_basic(self) -> None:
+        if not is_vote_type_valid(self.vote_type):
+            raise ValueError("invalid vote type")
+        if self.height < 0:
+            raise ValueError("negative height")
+        if self.round < 0:
+            raise ValueError("negative round")
+        self.block_id.validate_basic()
+        if len(self.validator_address) != ADDRESS_SIZE:
+            raise ValueError("bad validator address size")
+        if self.validator_index < 0:
+            raise ValueError("negative validator index")
+        if not self.signature:
+            raise ValueError("missing signature")
+        if len(self.signature) > 64:
+            raise ValueError("signature too big")
+
+    # wire codec -----------------------------------------------------------
+    def encode(self, w: Writer) -> None:
+        w.uvarint(int(self.vote_type)).svarint(self.height).svarint(self.round)
+        w.fixed64(self.timestamp_ns)
+        self.block_id.encode(w)
+        w.bytes(self.validator_address).uvarint(self.validator_index)
+        w.bytes(self.signature)
+
+    def marshal(self) -> bytes:
+        w = Writer()
+        self.encode(w)
+        return w.build()
+
+    @classmethod
+    def decode(cls, r: Reader) -> "Vote":
+        return cls(
+            vote_type=SignedMsgType(r.uvarint()),
+            height=r.svarint(),
+            round=r.svarint(),
+            timestamp_ns=r.fixed64(),
+            block_id=BlockID.decode(r),
+            validator_address=r.bytes(),
+            validator_index=r.uvarint(),
+            signature=r.bytes(),
+        )
+
+    @classmethod
+    def unmarshal(cls, data: bytes) -> "Vote":
+        return cls.decode(Reader(data))
+
+    def __str__(self) -> str:
+        t = "Prevote" if self.vote_type == SignedMsgType.PREVOTE else "Precommit"
+        blk = self.block_id.hash.hex()[:12] or "nil"
+        return (
+            f"Vote{{{self.validator_index}:{self.validator_address.hex()[:12]} "
+            f"{self.height}/{self.round:02d} {t} {blk}}}"
+        )

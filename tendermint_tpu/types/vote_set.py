@@ -1,0 +1,266 @@
+"""VoteSet — collects votes of one (height, round, type) from a validator set
+and detects +2/3 majorities (ref: types/vote_set.go).
+
+Semantics mirrored from the reference:
+  * one vote per validator index; a conflicting (same HRS/type, different
+    block) vote raises ErrVoteConflictingVotes carrying both votes — the raw
+    material of DuplicateVoteEvidence (vote_set.go:142-291);
+  * a conflicting vote IS admitted into a block's tally if some peer claimed
+    +2/3 for that block via set_peer_maj23 (vote_set.go blockVotes logic) —
+    needed to track commits we might be wrong about;
+  * maj23 latches the first block to cross 2/3 of total power;
+  * MakeCommit emits the Commit (precommits array indexed by validator)
+    (vote_set.go:531).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from tendermint_tpu.libs.bit_array import BitArray
+from tendermint_tpu.types.core import BlockID, SignedMsgType, is_vote_type_valid
+from tendermint_tpu.types.validator_set import ValidatorSet
+from tendermint_tpu.types.vote import (
+    ErrVoteConflictingVotes,
+    ErrVoteInvalidValidatorAddress,
+    ErrVoteInvalidValidatorIndex,
+    ErrVoteNonDeterministicSignature,
+    Vote,
+    VoteError,
+)
+
+
+class ErrVoteUnexpectedStep(VoteError):
+    pass
+
+
+@dataclass
+class _BlockVotes:
+    """Tally for a single BlockID within the set."""
+
+    peer_maj23: bool
+    bit_array: BitArray
+    votes: List[Optional[Vote]]
+    sum: int = 0
+
+    @classmethod
+    def new(cls, peer_maj23: bool, num_validators: int) -> "_BlockVotes":
+        return cls(
+            peer_maj23=peer_maj23,
+            bit_array=BitArray(num_validators),
+            votes=[None] * num_validators,
+        )
+
+    def add_verified_vote(self, vote: Vote, voting_power: int) -> None:
+        idx = vote.validator_index
+        if self.votes[idx] is None:
+            self.bit_array.set_index(idx, True)
+            self.votes[idx] = vote
+            self.sum += voting_power
+
+    def get_by_index(self, idx: int) -> Optional[Vote]:
+        return self.votes[idx]
+
+
+class VoteSet:
+    def __init__(
+        self,
+        chain_id: str,
+        height: int,
+        round: int,
+        signed_msg_type: SignedMsgType,
+        val_set: ValidatorSet,
+    ):
+        if height == 0:
+            raise ValueError("cannot make VoteSet for height == 0")
+        if not is_vote_type_valid(signed_msg_type):
+            raise ValueError("invalid vote type")
+        self.chain_id = chain_id
+        self.height = height
+        self.round = round
+        self.signed_msg_type = signed_msg_type
+        self.val_set = val_set
+
+        n = val_set.size
+        self._votes_bit_array = BitArray(n)
+        self._votes: List[Optional[Vote]] = [None] * n
+        self._sum = 0
+        self._maj23: Optional[BlockID] = None
+        self._votes_by_block: Dict[bytes, _BlockVotes] = {}
+        self._peer_maj23s: Dict[str, BlockID] = {}
+
+    # queries --------------------------------------------------------------
+    @property
+    def size(self) -> int:
+        return self.val_set.size
+
+    def bit_array(self) -> BitArray:
+        return self._votes_bit_array.copy()
+
+    def bit_array_by_block_id(self, block_id: BlockID) -> Optional[BitArray]:
+        bv = self._votes_by_block.get(block_id.key())
+        return bv.bit_array.copy() if bv else None
+
+    def get_by_index(self, idx: int) -> Optional[Vote]:
+        if 0 <= idx < len(self._votes):
+            return self._votes[idx]
+        return None
+
+    def get_by_address(self, address: bytes) -> Optional[Vote]:
+        idx, _ = self.val_set.get_by_address(address)
+        return self.get_by_index(idx) if idx >= 0 else None
+
+    def has_two_thirds_majority(self) -> bool:
+        return self._maj23 is not None
+
+    def two_thirds_majority(self) -> Optional[BlockID]:
+        return self._maj23
+
+    def has_two_thirds_any(self) -> bool:
+        return self._sum * 3 > self.val_set.total_voting_power() * 2
+
+    def has_all(self) -> bool:
+        return self._sum == self.val_set.total_voting_power()
+
+    def is_commit(self) -> bool:
+        return (
+            self.signed_msg_type == SignedMsgType.PRECOMMIT
+            and self._maj23 is not None
+        )
+
+    # mutation -------------------------------------------------------------
+    def add_vote(self, vote: Optional[Vote]) -> bool:
+        """Returns True if the vote was added; raises VoteError subclasses on
+        invalid/conflicting votes (ref vote_set.go:131-291)."""
+        if vote is None:
+            raise VoteError("nil vote")
+        idx = vote.validator_index
+        if idx < 0:
+            raise ErrVoteInvalidValidatorIndex()
+        if (
+            vote.height != self.height
+            or vote.round != self.round
+            or vote.vote_type != self.signed_msg_type
+        ):
+            raise ErrVoteUnexpectedStep(
+                f"expected {self.height}/{self.round}/{self.signed_msg_type}"
+            )
+        addr, val = self.val_set.get_by_index(idx)
+        if val is None:
+            raise ErrVoteInvalidValidatorIndex()
+        if addr != vote.validator_address:
+            raise ErrVoteInvalidValidatorAddress()
+
+        # dedup before paying for signature verification (ref getVote: checks
+        # both the main tally and this block's tracker)
+        existing = self._get_vote(idx, vote.block_id.key())
+        if existing is not None:
+            if existing.signature == vote.signature:
+                return False  # duplicate
+            raise ErrVoteNonDeterministicSignature()
+
+        vote.verify(self.chain_id, val.pub_key)
+
+        return self._add_verified_vote(vote, val.voting_power)
+
+    def _get_vote(self, idx: int, key: bytes) -> Optional[Vote]:
+        existing = self._votes[idx]
+        if existing is not None and existing.block_id.key() == key:
+            return existing
+        bv = self._votes_by_block.get(key)
+        if bv is not None:
+            return bv.get_by_index(idx)
+        return None
+
+    def _add_verified_vote(self, vote: Vote, voting_power: int) -> bool:
+        """Exact mirror of vote_set.go:218-291 addVerifiedVote.  A conflicting
+        vote raises ErrVoteConflictingVotes, but — when its block is tracked
+        with a peer maj23 claim — is STILL admitted into that block's tally
+        (and replaces the main-tally vote if that block already latched maj23)
+        before the raise; the exception's .added flag reports it."""
+        idx = vote.validator_index
+        key = vote.block_id.key()
+        conflicting: Optional[Vote] = None
+
+        existing = self._votes[idx]
+        if existing is not None:
+            # same-block duplicates were rejected by _get_vote upstream
+            conflicting = existing
+            # replace if this vote is for the latched maj23 block
+            if self._maj23 is not None and self._maj23.key() == key:
+                self._votes[idx] = vote
+                self._votes_bit_array.set_index(idx, True)
+        else:
+            self._votes[idx] = vote
+            self._votes_bit_array.set_index(idx, True)
+            self._sum += voting_power
+
+        bv = self._votes_by_block.get(key)
+        if bv is not None:
+            if conflicting is not None and not bv.peer_maj23:
+                # conflict and no peer claims this block is special
+                err = ErrVoteConflictingVotes(conflicting, vote)
+                err.added = False
+                raise err
+        else:
+            if conflicting is not None:
+                # not even tracking this block — forget it
+                err = ErrVoteConflictingVotes(conflicting, vote)
+                err.added = False
+                raise err
+            bv = _BlockVotes.new(peer_maj23=False, num_validators=self.val_set.size)
+            self._votes_by_block[key] = bv
+
+        orig_sum = bv.sum
+        quorum = self.val_set.total_voting_power() * 2 // 3 + 1
+        bv.add_verified_vote(vote, voting_power)
+
+        if orig_sum < quorum <= bv.sum and self._maj23 is None:
+            # only the first quorum latches; promote its votes to main tally
+            self._maj23 = vote.block_id
+            for i, v in enumerate(bv.votes):
+                if v is not None:
+                    self._votes[i] = v
+
+        if conflicting is not None:
+            err = ErrVoteConflictingVotes(conflicting, vote)
+            err.added = True
+            raise err
+        return True
+
+    def set_peer_maj23(self, peer_id: str, block_id: BlockID) -> None:
+        """A peer claims +2/3 for block_id: start tracking conflicting votes
+        for that block (ref vote_set.go SetPeerMaj23)."""
+        existing = self._peer_maj23s.get(peer_id)
+        if existing is not None:
+            if existing == block_id:
+                return
+            raise VoteError(f"peer {peer_id} changed its maj23 claim")
+        self._peer_maj23s[peer_id] = block_id
+        bv = self._votes_by_block.get(block_id.key())
+        if bv is not None:
+            bv.peer_maj23 = True
+        else:
+            self._votes_by_block[block_id.key()] = _BlockVotes.new(
+                peer_maj23=True, num_validators=self.val_set.size
+            )
+
+    # commit ---------------------------------------------------------------
+    def make_commit(self):
+        from tendermint_tpu.types.block import Commit
+
+        if self.signed_msg_type != SignedMsgType.PRECOMMIT:
+            raise VoteError("cannot MakeCommit() unless VoteSet is precommits")
+        if self._maj23 is None:
+            raise VoteError("cannot MakeCommit() unless a blockhash has +2/3")
+        # the MAIN tally, not the per-block tracker (vote_set.go:543): stray
+        # precommits for other blocks ride along to measure availability
+        return Commit(block_id=self._maj23, precommits=list(self._votes))
+
+    def __str__(self) -> str:
+        t = "Prevote" if self.signed_msg_type == SignedMsgType.PREVOTE else "Precommit"
+        return (
+            f"VoteSet{{H:{self.height} R:{self.round} {t} "
+            f"{self._votes_bit_array} sum:{self._sum}}}"
+        )

@@ -1,0 +1,219 @@
+"""Runtime libs: service lifecycle, KV dbs, autofile groups, pubsub queries,
+clist, events, fail points, flowrate."""
+
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from tendermint_tpu.libs.autofile import Group
+from tendermint_tpu.libs.clist import CList
+from tendermint_tpu.libs.db.kv import MemDB, PrefixDB, SQLiteDB, new_db
+from tendermint_tpu.libs.events import EventSwitch
+from tendermint_tpu.libs.pubsub import (
+    DuplicateSubscriptionError,
+    Query,
+    QueryError,
+    Server,
+)
+from tendermint_tpu.libs.service import AlreadyStartedError, BaseService
+
+
+class TestService:
+    def test_lifecycle(self):
+        calls = []
+
+        class S(BaseService):
+            def on_start(self):
+                calls.append("start")
+
+            def on_stop(self):
+                calls.append("stop")
+
+        s = S()
+        s.start()
+        assert s.is_running
+        with pytest.raises(AlreadyStartedError):
+            s.start()
+        s.stop()
+        assert not s.is_running
+        s.reset()
+        s.start()
+        assert calls == ["start", "stop", "start"]
+
+
+class TestDB:
+    @pytest.mark.parametrize("mk", ["memdb", "sqlite"])
+    def test_crud_and_iteration(self, mk, tmp_path):
+        db = new_db("test", mk, str(tmp_path))
+        db.set(b"b", b"2")
+        db.set(b"a", b"1")
+        db.set(b"c", b"3")
+        assert db.get(b"b") == b"2"
+        assert db.get(b"zz") is None
+        db.delete(b"b")
+        assert not db.has(b"b")
+        assert list(db.iterator()) == [(b"a", b"1"), (b"c", b"3")]
+        assert list(db.iterator(reverse=True)) == [(b"c", b"3"), (b"a", b"1")]
+        db.set(b"b", b"2")
+        assert list(db.iterator(start=b"b")) == [(b"b", b"2"), (b"c", b"3")]
+        assert list(db.iterator(end=b"b")) == [(b"a", b"1")]
+
+    def test_sqlite_durability(self, tmp_path):
+        db = SQLiteDB("dur", str(tmp_path))
+        db.set_sync(b"k", b"v")
+        db.close()
+        db2 = SQLiteDB("dur", str(tmp_path))
+        assert db2.get(b"k") == b"v"
+
+    def test_prefixdb(self, tmp_path):
+        base = MemDB()
+        p1 = PrefixDB(base, b"one/")
+        p2 = PrefixDB(base, b"two/")
+        p1.set(b"k", b"v1")
+        p2.set(b"k", b"v2")
+        assert p1.get(b"k") == b"v1" and p2.get(b"k") == b"v2"
+        p1.set(b"k2", b"v3")
+        assert list(p1.iterator()) == [(b"k", b"v1"), (b"k2", b"v3")]
+
+    def test_batch(self):
+        db = MemDB()
+        db.batch().set(b"x", b"1").set(b"y", b"2").delete(b"x").write()
+        assert db.get(b"x") is None and db.get(b"y") == b"2"
+
+
+class TestAutofile:
+    def test_write_rotate_read(self, tmp_path):
+        head = str(tmp_path / "wal")
+        g = Group(head, head_size_limit=100)
+        payload = []
+        for i in range(10):
+            data = f"entry-{i:02d}-".encode() * 4  # 36 bytes each
+            payload.append(data)
+            g.write(data)
+            g.flush()
+            g.maybe_rotate()
+        assert g.max_index > 0  # rotated at least once
+        r = g.new_reader()
+        assert r.read() == b"".join(payload)
+        g.close()
+
+    def test_reopen_scans_indices(self, tmp_path):
+        head = str(tmp_path / "wal")
+        g = Group(head, head_size_limit=50)
+        g.write(b"a" * 60)
+        g.maybe_rotate()
+        g.write(b"b" * 10)
+        g.close()
+        g2 = Group(head, head_size_limit=50)
+        assert g2.max_index == 1
+        r = g2.new_reader()
+        assert r.read() == b"a" * 60 + b"b" * 10
+
+    def test_total_size_pruning(self, tmp_path):
+        g = Group(str(tmp_path / "wal"), head_size_limit=100, total_size_limit=250)
+        for _ in range(10):
+            g.write(b"z" * 100)
+            g.maybe_rotate()
+        assert g.total_size() <= 350  # ~limit + one head
+        assert g.min_index > 0  # oldest pruned
+
+
+class TestPubSubQuery:
+    def test_match_eq_and_numeric(self):
+        q = Query("tm.event = 'NewBlock' AND tx.height > 5")
+        assert q.matches({"tm.event": "NewBlock", "tx.height": "6"})
+        assert not q.matches({"tm.event": "NewBlock", "tx.height": "5"})
+        assert not q.matches({"tm.event": "Tx", "tx.height": "6"})
+        assert not q.matches({"tm.event": "NewBlock"})
+
+    def test_contains_and_neq(self):
+        q = Query("account.name CONTAINS 'igor' AND tx.type != 'send'")
+        assert q.matches({"account.name": "igor2", "tx.type": "recv"})
+        assert not q.matches({"account.name": "bob", "tx.type": "recv"})
+
+    def test_bad_queries(self):
+        for s in ["", "AND", "a = ", "= 'x'", "a ? 'x'"]:
+            with pytest.raises(QueryError):
+                Query(s)
+
+    def test_server_pub_sub(self):
+        srv = Server()
+        sub = srv.subscribe("client1", "tm.event = 'Tx'")
+        srv.publish("hello", {"tm.event": "Tx"})
+        srv.publish("nope", {"tm.event": "NewBlock"})
+        assert sub.get(timeout=1).data == "hello"
+        assert sub.queue.empty()
+        with pytest.raises(DuplicateSubscriptionError):
+            srv.subscribe("client1", "tm.event = 'Tx'")
+        srv.unsubscribe("client1", "tm.event = 'Tx'")
+        assert srv.num_clients() == 0
+
+
+class TestCList:
+    def test_push_remove_iterate(self):
+        cl = CList()
+        els = [cl.push_back(i) for i in range(5)]
+        assert list(cl) == [0, 1, 2, 3, 4]
+        cl.remove(els[2])
+        assert list(cl) == [0, 1, 3, 4]
+        assert len(cl) == 4
+        cl.remove(els[0])
+        assert cl.front().value == 1
+
+    def test_next_wait_blocks_until_push(self):
+        cl = CList()
+        el = cl.push_back("first")
+        got = []
+
+        def waiter():
+            got.append(el.next_wait(timeout=5))
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        time.sleep(0.05)
+        cl.push_back("second")
+        t.join(timeout=5)
+        assert got and got[0].value == "second"
+
+
+class TestEvents:
+    def test_fire_and_remove(self):
+        sw = EventSwitch()
+        seen = []
+        sw.add_listener_for_event("l1", "step", lambda d: seen.append(d))
+        sw.fire_event("step", 1)
+        sw.remove_listener("l1")
+        sw.fire_event("step", 2)
+        assert seen == [1]
+
+
+class TestFail:
+    def test_fail_point_kills_at_index(self, tmp_path):
+        code = (
+            "from tendermint_tpu.libs import fail\n"
+            "for i in range(5):\n"
+            "    fail.fail_point()\n"
+            "    print('survived', i, flush=True)\n"
+        )
+        env = dict(os.environ, FAIL_TEST_INDEX="2", JAX_PLATFORMS="cpu")
+        env["PYTHONPATH"] = (
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+            + os.pathsep
+            + env.get("PYTHONPATH", "")
+        )
+        p = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True, text=True, env=env
+        )
+        assert p.returncode == 1
+        assert p.stdout.splitlines() == ["survived 0", "survived 1"]
+
+    def test_no_env_no_kill(self):
+        from tendermint_tpu.libs import fail
+
+        fail.reset(None)
+        for _ in range(3):
+            fail.fail_point()

@@ -64,6 +64,24 @@ class TestCommitWindow:
         assert (tally == want_tally).all()
         assert (committed == (want_tally * 3 > total * 2)).all()
 
+    def test_int64_powers_do_not_wrap(self):
+        """Regression: voting powers near the reference's 2^60 clip must tally
+        exactly on device (int32 canonicalization would wrap them)."""
+        from tendermint_tpu.parallel.commit_verify import (
+            pack_commit_window,
+            verify_commit_window,
+        )
+
+        triples = _signed(3)
+        big = 3_000_000_000  # > 2^31
+        votes = [[(p, m, s) for (p, m, s) in triples]]
+        powers = [[big, big, big]]
+        win = pack_commit_window(votes, powers)
+        ok, tally, committed = verify_commit_window(win, total_power=3 * big)
+        assert ok.all()
+        assert tally.tolist() == [3 * big]
+        assert committed.tolist() == [True]
+
     def test_sharded_2d_mesh(self):
         import jax
         from jax.sharding import Mesh

@@ -1,0 +1,502 @@
+"""Domain types: codec roundtrips, vote/proposal signing, validator set
+rotation + batched commit verification, vote set tallies, part sets, blocks.
+
+Mirrors the reference's table-driven coverage of types/ (SURVEY.md §4)."""
+
+import time
+
+import pytest
+
+from tendermint_tpu.crypto.keys import PrivKeyEd25519
+from tendermint_tpu.encoding.codec import Reader, Writer
+from tendermint_tpu.libs.bit_array import BitArray
+from tendermint_tpu.types import (
+    Block,
+    BlockID,
+    Commit,
+    CommitError,
+    DuplicateVoteEvidence,
+    GenesisDoc,
+    GenesisValidator,
+    MockPV,
+    PartSet,
+    PartSetHeader,
+    Proposal,
+    SignedMsgType,
+    Validator,
+    ValidatorSet,
+    Vote,
+    VoteSet,
+)
+from tendermint_tpu.types.vote import ErrVoteConflictingVotes
+from tendermint_tpu.types.vote_set import ErrVoteUnexpectedStep
+
+CHAIN_ID = "test-chain"
+
+
+def make_vals(n, power=10):
+    """n (MockPV, Validator) pairs with equal power."""
+    pvs = [MockPV(PrivKeyEd25519.generate(bytes([i + 1]) * 32)) for i in range(n)]
+    vals = [Validator(pv.get_pub_key(), power) for pv in pvs]
+    vs = ValidatorSet(vals)
+    # index privvals by position in the sorted set
+    by_addr = {pv.get_pub_key().address(): pv for pv in pvs}
+    sorted_pvs = [by_addr[v.address] for v in vs.validators]
+    return vs, sorted_pvs
+
+
+def make_vote(pv, vs, height, round, vtype, block_id, ts=1_700_000_000_000_000_000):
+    addr = pv.get_pub_key().address()
+    idx, _ = vs.get_by_address(addr)
+    vote = Vote(
+        vote_type=vtype,
+        height=height,
+        round=round,
+        timestamp_ns=ts,
+        block_id=block_id,
+        validator_address=addr,
+        validator_index=idx,
+    )
+    return pv.sign_vote(CHAIN_ID, vote)
+
+
+def some_block_id(tag=b"x"):
+    return BlockID(
+        hash=bytes(tag) * 32 if len(tag) == 1 else tag,
+        parts_header=PartSetHeader(total=1, hash=b"p" * 32),
+    )
+
+
+class TestCodec:
+    @pytest.mark.parametrize("v", [0, 1, 127, 128, 300, 2**32, 2**63 - 1])
+    def test_uvarint_roundtrip(self, v):
+        w = Writer()
+        w.uvarint(v)
+        assert Reader(w.build()).uvarint() == v
+
+    @pytest.mark.parametrize("v", [0, -1, 1, -64, 64, -2**62, 2**62])
+    def test_svarint_roundtrip(self, v):
+        w = Writer()
+        w.svarint(v)
+        assert Reader(w.build()).svarint() == v
+
+    @pytest.mark.parametrize("v", [0, -1, 2**62, -(2**62)])
+    def test_fixed64_roundtrip(self, v):
+        w = Writer()
+        w.fixed64(v)
+        assert Reader(w.build()).fixed64() == v
+
+    def test_mixed_stream(self):
+        w = Writer()
+        w.string("hello").bytes(b"\x00\xff").bool(True).svarint(-5)
+        r = Reader(w.build())
+        assert r.string() == "hello"
+        assert r.bytes() == b"\x00\xff"
+        assert r.bool() is True
+        assert r.svarint() == -5
+        assert r.at_end()
+
+
+class TestBitArray:
+    def test_ops(self):
+        a = BitArray(10)
+        a.set_index(1, True)
+        a.set_index(5, True)
+        b = BitArray(10)
+        b.set_index(5, True)
+        b.set_index(7, True)
+        assert a.sub(b).true_indices() == [1]
+        assert a.or_(b).true_indices() == [1, 5, 7]
+        assert a.and_(b).true_indices() == [5]
+        assert not a.is_full() and not a.is_empty()
+        assert BitArray(3, 0b111).is_full()
+
+    def test_pick_random_and_codec(self):
+        a = BitArray(70)
+        a.set_index(69, True)
+        assert a.pick_random() == 69
+        assert BitArray.unmarshal(a.marshal()) == a
+
+
+class TestVote:
+    def test_sign_verify_roundtrip(self):
+        vs, pvs = make_vals(1)
+        vote = make_vote(pvs[0], vs, 5, 0, SignedMsgType.PREVOTE, some_block_id())
+        vote.verify(CHAIN_ID, pvs[0].get_pub_key())
+        assert Vote.unmarshal(vote.marshal()) == vote
+
+    def test_verify_rejects_wrong_chain(self):
+        vs, pvs = make_vals(1)
+        vote = make_vote(pvs[0], vs, 5, 0, SignedMsgType.PREVOTE, some_block_id())
+        from tendermint_tpu.types.vote import ErrVoteInvalidSignature
+
+        with pytest.raises(ErrVoteInvalidSignature):
+            bad = Vote(
+                vote_type=vote.vote_type, height=vote.height, round=vote.round,
+                timestamp_ns=vote.timestamp_ns, block_id=vote.block_id,
+                validator_address=vote.validator_address,
+                validator_index=vote.validator_index,
+                signature=vote.signature,
+            )
+            object.__setattr__(bad, "height", vote.height + 1)
+            bad.verify(CHAIN_ID, pvs[0].get_pub_key())
+
+    def test_sign_bytes_distinct_fields(self):
+        vs, pvs = make_vals(1)
+        base = make_vote(pvs[0], vs, 5, 0, SignedMsgType.PREVOTE, some_block_id())
+        others = [
+            make_vote(pvs[0], vs, 6, 0, SignedMsgType.PREVOTE, some_block_id()),
+            make_vote(pvs[0], vs, 5, 1, SignedMsgType.PREVOTE, some_block_id()),
+            make_vote(pvs[0], vs, 5, 0, SignedMsgType.PRECOMMIT, some_block_id()),
+            make_vote(pvs[0], vs, 5, 0, SignedMsgType.PREVOTE, BlockID()),
+        ]
+        sbs = {v.sign_bytes(CHAIN_ID) for v in [base] + others}
+        assert len(sbs) == 5
+        assert base.sign_bytes("other-chain") != base.sign_bytes(CHAIN_ID)
+
+
+class TestValidatorSet:
+    def test_sorted_by_address(self):
+        vs, _ = make_vals(5)
+        addrs = [v.address for v in vs.validators]
+        assert addrs == sorted(addrs)
+
+    def test_proposer_rotation_is_fair(self):
+        vs, _ = make_vals(4)
+        counts = {}
+        for _ in range(400):
+            p = vs.get_proposer()
+            counts[p.address] = counts.get(p.address, 0) + 1
+            vs.increment_accum(1)
+        assert all(c == 100 for c in counts.values()), counts
+
+    def test_proposer_rotation_weighted(self):
+        pvs = [MockPV(PrivKeyEd25519.generate(bytes([i + 1]) * 32)) for i in range(3)]
+        vals = [
+            Validator(pvs[0].get_pub_key(), 1),
+            Validator(pvs[1].get_pub_key(), 2),
+            Validator(pvs[2].get_pub_key(), 3),
+        ]
+        vs = ValidatorSet(vals)
+        counts = {1: 0, 2: 0, 3: 0}
+        for _ in range(600):
+            counts[vs.get_proposer().voting_power] += 1
+            vs.increment_accum(1)
+        assert counts == {1: 100, 2: 200, 3: 300}
+
+    def test_hash_changes_with_membership(self):
+        vs, _ = make_vals(3)
+        h1 = vs.hash()
+        extra = MockPV(PrivKeyEd25519.generate(b"\x77" * 32))
+        vs.add(Validator(extra.get_pub_key(), 5))
+        assert vs.hash() != h1
+
+    def test_marshal_roundtrip(self):
+        vs, _ = make_vals(3)
+        rt = ValidatorSet.unmarshal(vs.marshal())
+        assert rt.hash() == vs.hash()
+        assert rt.get_proposer().address == vs.get_proposer().address
+
+
+def build_commit(vs, pvs, height, block_id, round=0, skip=(), wrong_block=()):
+    precommits = []
+    for i, v in enumerate(vs.validators):
+        if i in skip:
+            precommits.append(None)
+            continue
+        bid = some_block_id(b"z") if i in wrong_block else block_id
+        precommits.append(
+            make_vote(pvs[i], vs, height, round, SignedMsgType.PRECOMMIT, bid)
+        )
+    return Commit(block_id=block_id, precommits=precommits)
+
+
+class TestVerifyCommit:
+    def test_happy_path(self):
+        vs, pvs = make_vals(4)
+        bid = some_block_id()
+        commit = build_commit(vs, pvs, 3, bid)
+        vs.verify_commit(CHAIN_ID, bid, 3, commit)
+
+    def test_some_nil_ok(self):
+        vs, pvs = make_vals(4)
+        bid = some_block_id()
+        commit = build_commit(vs, pvs, 3, bid, skip=(1,))
+        vs.verify_commit(CHAIN_ID, bid, 3, commit)  # 3/4 power > 2/3
+
+    def test_insufficient_power(self):
+        vs, pvs = make_vals(4)
+        bid = some_block_id()
+        commit = build_commit(vs, pvs, 3, bid, skip=(1, 2))
+        with pytest.raises(CommitError, match="insufficient"):
+            vs.verify_commit(CHAIN_ID, bid, 3, commit)
+
+    def test_bad_signature_fails_whole_commit(self):
+        vs, pvs = make_vals(4)
+        bid = some_block_id()
+        commit = build_commit(vs, pvs, 3, bid)
+        tampered = commit.precommits[2].with_signature(b"\x00" * 64)
+        commit.precommits[2] = tampered
+        with pytest.raises(CommitError, match="invalid signature"):
+            vs.verify_commit(CHAIN_ID, bid, 3, commit)
+
+    def test_stray_precommits_count_for_availability_not_power(self):
+        vs, pvs = make_vals(4)
+        bid = some_block_id()
+        # 2 vote for block, 2 for other block: verification passes per-sig but
+        # power is insufficient
+        commit = build_commit(vs, pvs, 3, bid, wrong_block=(0, 1))
+        with pytest.raises(CommitError, match="insufficient"):
+            vs.verify_commit(CHAIN_ID, bid, 3, commit)
+
+    def test_wrong_set_size(self):
+        vs, pvs = make_vals(4)
+        vs2, _ = make_vals(3)
+        bid = some_block_id()
+        commit = build_commit(vs, pvs, 3, bid)
+        with pytest.raises(CommitError, match="set size"):
+            vs2.verify_commit(CHAIN_ID, bid, 3, commit)
+
+    def test_future_commit_old_set_power(self):
+        vs, pvs = make_vals(4)
+        bid = some_block_id()
+        commit = build_commit(vs, pvs, 7, bid)
+        # same set as "new set" — trivially passes both legs
+        vs.verify_future_commit(vs, CHAIN_ID, bid, 7, commit)
+
+
+class TestVoteSet:
+    def test_maj23_latches(self):
+        vs, pvs = make_vals(4)
+        voteset = VoteSet(CHAIN_ID, 2, 0, SignedMsgType.PREVOTE, vs)
+        bid = some_block_id()
+        for i in range(3):
+            added = voteset.add_vote(make_vote(pvs[i], vs, 2, 0, SignedMsgType.PREVOTE, bid))
+            assert added
+        assert voteset.two_thirds_majority() == bid
+        assert voteset.has_two_thirds_any()
+
+    def test_no_maj23_split(self):
+        vs, pvs = make_vals(4)
+        voteset = VoteSet(CHAIN_ID, 2, 0, SignedMsgType.PREVOTE, vs)
+        voteset.add_vote(make_vote(pvs[0], vs, 2, 0, SignedMsgType.PREVOTE, some_block_id(b"a")))
+        voteset.add_vote(make_vote(pvs[1], vs, 2, 0, SignedMsgType.PREVOTE, some_block_id(b"b")))
+        voteset.add_vote(make_vote(pvs[2], vs, 2, 0, SignedMsgType.PREVOTE, BlockID()))
+        assert voteset.two_thirds_majority() is None
+        assert voteset.has_two_thirds_any()
+
+    def test_duplicate_vote_not_added(self):
+        vs, pvs = make_vals(4)
+        voteset = VoteSet(CHAIN_ID, 2, 0, SignedMsgType.PREVOTE, vs)
+        v = make_vote(pvs[0], vs, 2, 0, SignedMsgType.PREVOTE, some_block_id())
+        assert voteset.add_vote(v)
+        assert not voteset.add_vote(v)
+
+    def test_conflicting_vote_raises_evidence(self):
+        vs, pvs = make_vals(4)
+        voteset = VoteSet(CHAIN_ID, 2, 0, SignedMsgType.PREVOTE, vs)
+        v1 = make_vote(pvs[0], vs, 2, 0, SignedMsgType.PREVOTE, some_block_id(b"a"))
+        v2 = make_vote(pvs[0], vs, 2, 0, SignedMsgType.PREVOTE, some_block_id(b"b"))
+        voteset.add_vote(v1)
+        with pytest.raises(ErrVoteConflictingVotes) as ei:
+            voteset.add_vote(v2)
+        assert ei.value.vote_a == v1 and ei.value.vote_b == v2
+
+    def test_conflict_tracked_after_peer_maj23(self):
+        """Exact reference semantics (vote_set.go:244-251): with a peer maj23
+        claim, the conflicting vote IS admitted to that block's tally and the
+        conflict error still surfaces (added=True)."""
+        vs, pvs = make_vals(4)
+        voteset = VoteSet(CHAIN_ID, 2, 0, SignedMsgType.PRECOMMIT, vs)
+        bid_a, bid_b = some_block_id(b"a"), some_block_id(b"b")
+        voteset.add_vote(make_vote(pvs[0], vs, 2, 0, SignedMsgType.PRECOMMIT, bid_a))
+        voteset.set_peer_maj23("peer1", bid_b)
+        v2 = make_vote(pvs[0], vs, 2, 0, SignedMsgType.PRECOMMIT, bid_b)
+        with pytest.raises(ErrVoteConflictingVotes) as ei:
+            voteset.add_vote(v2)
+        assert ei.value.added is True
+        assert voteset.bit_array_by_block_id(bid_b).num_true() == 1
+        # main tally keeps the first vote (no maj23 latched for bid_b)
+        assert voteset.get_by_index(0).block_id == bid_a
+
+    def test_maj23_replacement_on_conflict(self):
+        """vote_set.go:227-229: once maj23 latches for X, a conflicting vote
+        FOR X from a validator who voted Y replaces the main-tally vote, so
+        MakeCommit carries the maj23-block precommit."""
+        vs, pvs = make_vals(4)
+        voteset = VoteSet(CHAIN_ID, 2, 0, SignedMsgType.PRECOMMIT, vs)
+        bid_x, bid_y = some_block_id(b"x"), some_block_id(b"y")
+        # validator 0 votes Y first
+        voteset.add_vote(make_vote(pvs[0], vs, 2, 0, SignedMsgType.PRECOMMIT, bid_y))
+        # 1,2,3 vote X -> maj23 latches on X
+        for i in (1, 2, 3):
+            voteset.add_vote(make_vote(pvs[i], vs, 2, 0, SignedMsgType.PRECOMMIT, bid_x))
+        assert voteset.two_thirds_majority() == bid_x
+        # validator 0's late X vote conflicts with its Y vote; Go replaces the
+        # main-tally vote (since X == maj23) but reports added=false because
+        # X's block tracker has no peer-maj23 claim
+        vx = make_vote(pvs[0], vs, 2, 0, SignedMsgType.PRECOMMIT, bid_x)
+        with pytest.raises(ErrVoteConflictingVotes) as ei:
+            voteset.add_vote(vx)
+        assert ei.value.added is False
+        assert voteset.get_by_index(0).block_id == bid_x
+        commit = voteset.make_commit()
+        assert sum(1 for pc in commit.precommits if pc is not None) == 4
+        vs.verify_commit(CHAIN_ID, bid_x, 2, commit)
+
+    def test_wrong_round_rejected(self):
+        vs, pvs = make_vals(4)
+        voteset = VoteSet(CHAIN_ID, 2, 0, SignedMsgType.PREVOTE, vs)
+        with pytest.raises(ErrVoteUnexpectedStep):
+            voteset.add_vote(make_vote(pvs[0], vs, 2, 1, SignedMsgType.PREVOTE, some_block_id()))
+
+    def test_make_commit(self):
+        vs, pvs = make_vals(4)
+        voteset = VoteSet(CHAIN_ID, 2, 0, SignedMsgType.PRECOMMIT, vs)
+        bid = some_block_id()
+        for i in range(3):
+            voteset.add_vote(make_vote(pvs[i], vs, 2, 0, SignedMsgType.PRECOMMIT, bid))
+        commit = voteset.make_commit()
+        assert commit.block_id == bid
+        assert sum(1 for pc in commit.precommits if pc is not None) == 3
+        vs.verify_commit(CHAIN_ID, bid, 2, commit)
+
+
+class TestPartSet:
+    def test_split_and_reassemble(self):
+        data = bytes(range(256)) * 1000  # 256000 bytes -> 4 parts
+        ps = PartSet.from_data(data)
+        assert ps.total == 4 and ps.is_complete()
+        # receiving side: assemble from gossiped parts
+        rx = PartSet(ps.header())
+        for i in [2, 0, 3, 1]:
+            part = ps.get_part(i)
+            assert rx.add_part(Part.unmarshal(part.marshal()) if False else part)
+        assert rx.is_complete()
+        assert rx.assemble() == data
+
+    def test_bad_proof_rejected(self):
+        from tendermint_tpu.types.part_set import ErrPartSetInvalidProof
+
+        data = b"q" * 100000
+        ps = PartSet.from_data(data)
+        other = PartSet.from_data(b"r" * 100000)
+        rx = PartSet(ps.header())
+        with pytest.raises(ErrPartSetInvalidProof):
+            rx.add_part(other.get_part(0))
+
+    def test_part_codec_roundtrip(self):
+        ps = PartSet.from_data(b"w" * 70000)
+        p = ps.get_part(1)
+        from tendermint_tpu.types.part_set import Part as PartCls
+
+        rt = PartCls.unmarshal(p.marshal())
+        assert rt.index == p.index and rt.bytes_ == p.bytes_
+        rx = PartSet(ps.header())
+        assert rx.add_part(rt)
+
+
+class TestBlock:
+    def _block(self):
+        vs, pvs = make_vals(4)
+        bid = some_block_id()
+        last_commit = build_commit(vs, pvs, 1, bid)
+        block = Block.make_block(2, [b"tx1", b"tx2"], last_commit)
+        block.header.validators_hash = vs.hash()
+        block.header.next_validators_hash = vs.hash()
+        block.header.chain_id = CHAIN_ID
+        block.header.proposer_address = vs.get_proposer().address
+        return block, vs
+
+    def test_hash_and_validate(self):
+        block, vs = self._block()
+        assert block.hash() is not None
+        block.validate_basic()
+
+    def test_marshal_roundtrip_preserves_hash(self):
+        block, _ = self._block()
+        rt = Block.unmarshal(block.marshal())
+        assert rt.hash() == block.hash()
+        rt.validate_basic()
+
+    def test_tamper_changes_hash(self):
+        block, _ = self._block()
+        h = block.hash()
+        block.data.txs.append(b"evil")
+        block.header.data_hash = block.data.hash()
+        assert block.hash() != h
+
+    def test_part_set_roundtrip(self):
+        block, _ = self._block()
+        ps = block.make_part_set(256)
+        assert ps.total > 1
+        rt = Block.unmarshal(ps.assemble())
+        assert rt.hash() == block.hash()
+
+
+class TestEvidence:
+    def test_duplicate_vote_evidence(self):
+        vs, pvs = make_vals(4)
+        v1 = make_vote(pvs[0], vs, 2, 0, SignedMsgType.PREVOTE, some_block_id(b"a"))
+        v2 = make_vote(pvs[0], vs, 2, 0, SignedMsgType.PREVOTE, some_block_id(b"b"))
+        ev = DuplicateVoteEvidence(pub_key=pvs[0].get_pub_key(), vote_a=v1, vote_b=v2)
+        ev.verify(CHAIN_ID)
+        rt = DuplicateVoteEvidence.unmarshal(ev.marshal())
+        assert rt.hash() == ev.hash()
+        # same-block pair is not evidence
+        from tendermint_tpu.types.evidence import EvidenceError
+
+        with pytest.raises(EvidenceError):
+            DuplicateVoteEvidence(
+                pub_key=pvs[0].get_pub_key(), vote_a=v1, vote_b=v1
+            ).verify(CHAIN_ID)
+
+
+class TestGenesis:
+    def test_json_roundtrip(self, tmp_path):
+        vs, pvs = make_vals(2)
+        doc = GenesisDoc(
+            chain_id=CHAIN_ID,
+            validators=[
+                GenesisValidator(pv.get_pub_key(), 10, f"v{i}")
+                for i, pv in enumerate(pvs)
+            ],
+        )
+        doc.validate_and_complete()
+        p = tmp_path / "genesis.json"
+        doc.save_as(str(p))
+        rt = GenesisDoc.from_file(str(p))
+        assert rt.chain_id == doc.chain_id
+        assert rt.validator_hash() == doc.validator_hash()
+        assert rt.genesis_time_ns == doc.genesis_time_ns
+
+
+class TestProposal:
+    def test_sign_and_roundtrip(self):
+        vs, pvs = make_vals(1)
+        prop = Proposal(
+            height=3, round=1, timestamp_ns=time.time_ns(),
+            block_id=some_block_id(),
+            pol_round=0,
+        )
+        signed = pvs[0].sign_proposal(CHAIN_ID, prop)
+        assert pvs[0].get_pub_key().verify_bytes(
+            signed.sign_bytes(CHAIN_ID), signed.signature
+        )
+        rt = Proposal.unmarshal(signed.marshal())
+        assert rt == signed
+
+    def test_signature_covers_block_id(self):
+        """Tampering block_id after signing must break verification."""
+        import dataclasses
+
+        vs, pvs = make_vals(1)
+        prop = Proposal(
+            height=3, round=1, timestamp_ns=time.time_ns(),
+            block_id=some_block_id(b"a"), pol_round=-1,
+        )
+        signed = pvs[0].sign_proposal(CHAIN_ID, prop)
+        tampered = dataclasses.replace(signed, block_id=some_block_id(b"b"))
+        assert not pvs[0].get_pub_key().verify_bytes(
+            tampered.sign_bytes(CHAIN_ID), tampered.signature
+        )
