@@ -1,0 +1,252 @@
+"""ABCI clients (ref: abci/client/).
+
+  * LocalClient  — in-proc app behind one mutex (local_client.go); zero-copy,
+    the production path for apps written against this framework.
+  * SocketClient — connects to a remote app over TCP/unix socket with
+    varint-length-delimited JSON frames (socket_client.go's pipeline shape:
+    async sends + Flush barriers).
+
+Async variants return a `ReqRes` future-like handle; `*_sync` block.
+"""
+
+from __future__ import annotations
+
+import queue
+import socket
+import struct
+import threading
+from typing import Any, Callable, List, Optional, Tuple
+
+from tendermint_tpu.abci import types as abci
+from tendermint_tpu.encoding.codec import encode_uvarint
+from tendermint_tpu.libs.service import BaseService
+
+
+class ABCIClientError(Exception):
+    pass
+
+
+class ReqRes:
+    """Pending request handle; callback fires on completion."""
+
+    def __init__(self, request: Any):
+        self.request = request
+        self.response: Any = None
+        self._done = threading.Event()
+        self._cb: Optional[Callable[[Any, Any], None]] = None
+        self._cb_mtx = threading.Lock()
+
+    def complete(self, response: Any) -> None:
+        self.response = response
+        self._done.set()
+        with self._cb_mtx:
+            cb = self._cb
+        if cb:
+            cb(self.request, response)
+
+    def wait(self, timeout: Optional[float] = None) -> Any:
+        if not self._done.wait(timeout):
+            raise ABCIClientError("ABCI request timed out")
+        return self.response
+
+    def set_callback(self, cb: Callable[[Any, Any], None]) -> None:
+        with self._cb_mtx:
+            self._cb = cb
+        if self._done.is_set():
+            cb(self.request, self.response)
+
+
+_METHODS = {
+    abci.RequestEcho: "echo",
+    abci.RequestInfo: "info",
+    abci.RequestSetOption: "set_option",
+    abci.RequestInitChain: "init_chain",
+    abci.RequestQuery: "query",
+    abci.RequestBeginBlock: "begin_block",
+    abci.RequestCheckTx: "check_tx",
+    abci.RequestDeliverTx: "deliver_tx",
+    abci.RequestEndBlock: "end_block",
+    abci.RequestCommit: "commit",
+}
+
+
+class LocalClient(BaseService):
+    """Mutex-serialized direct calls into an in-proc Application
+    (ref local_client.go)."""
+
+    def __init__(self, app: abci.Application, mtx: Optional[threading.Lock] = None):
+        super().__init__("abci.LocalClient")
+        self._app = app
+        self._mtx = mtx or threading.Lock()
+        self._global_cb: Optional[Callable[[Any, Any], None]] = None
+
+    def set_response_callback(self, cb: Callable[[Any, Any], None]) -> None:
+        self._global_cb = cb
+
+    def _call(self, req: Any) -> Any:
+        if isinstance(req, abci.RequestFlush):
+            return abci.ResponseFlush()
+        with self._mtx:
+            res = getattr(self._app, _METHODS[type(req)])(req)
+        return res
+
+    # async shape (completes synchronously in-proc) ------------------------
+    def request_async(self, req: Any) -> ReqRes:
+        rr = ReqRes(req)
+        res = self._call(req)
+        if self._global_cb:
+            self._global_cb(req, res)
+        rr.complete(res)
+        return rr
+
+    def request_sync(self, req: Any) -> Any:
+        return self.request_async(req).response
+
+    def flush_sync(self) -> None:
+        pass
+
+    def error(self) -> Optional[Exception]:
+        return None
+
+    # convenience typed wrappers (echo_sync, info_sync, ...) ---------------
+    def __getattr__(self, name: str):
+        if name.endswith("_sync") or name.endswith("_async"):
+            stem, _, kind = name.rpartition("_")
+            req_cls = {v: k for k, v in _METHODS.items()}.get(stem)
+            if req_cls is not None:
+                if kind == "sync":
+                    return lambda req=None: self.request_sync(req or req_cls())
+                return lambda req=None: self.request_async(req or req_cls())
+        raise AttributeError(name)
+
+
+class SocketClient(BaseService):
+    """Remote app over a stream socket; frames are uvarint(len) + JSON.
+    Requests pipeline; Flush forces the server to answer everything queued
+    (ref socket_client.go:406)."""
+
+    def __init__(self, addr: str, must_connect: bool = True):
+        super().__init__("abci.SocketClient")
+        self.addr = addr
+        self._sock: Optional[socket.socket] = None
+        self._pending: "queue.Queue[ReqRes]" = queue.Queue()
+        self._send_q: "queue.Queue[ReqRes]" = queue.Queue()
+        self._err: Optional[Exception] = None
+        self._global_cb: Optional[Callable[[Any, Any], None]] = None
+        self._must_connect = must_connect
+
+    def on_start(self) -> None:
+        self._sock = _dial(self.addr)
+        threading.Thread(target=self._send_loop, daemon=True).start()
+        threading.Thread(target=self._recv_loop, daemon=True).start()
+
+    def on_stop(self) -> None:
+        if self._sock:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+
+    def set_response_callback(self, cb: Callable[[Any, Any], None]) -> None:
+        self._global_cb = cb
+
+    def error(self) -> Optional[Exception]:
+        return self._err
+
+    def _send_loop(self) -> None:
+        while not self.quit_event.is_set():
+            try:
+                rr = self._send_q.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            try:
+                payload = abci.msg_to_json(rr.request)
+                self._sock.sendall(encode_uvarint(len(payload)) + payload)
+            except OSError as e:
+                self._err = e
+                return
+
+    def _recv_loop(self) -> None:
+        buf = b""
+        while not self.quit_event.is_set():
+            try:
+                frame, buf = _read_frame(self._sock, buf)
+            except OSError as e:
+                self._err = e
+                return
+            if frame is None:
+                self._err = ABCIClientError("server closed connection")
+                return
+            res = abci.msg_from_json(frame)
+            try:
+                rr = self._pending.get_nowait()
+            except queue.Empty:
+                self._err = ABCIClientError("unexpected response")
+                return
+            if self._global_cb:
+                self._global_cb(rr.request, res)
+            rr.complete(res)
+
+    def request_async(self, req: Any) -> ReqRes:
+        rr = ReqRes(req)
+        self._pending.put(rr)
+        self._send_q.put(rr)
+        return rr
+
+    def request_sync(self, req: Any, timeout: float = 10.0) -> Any:
+        rr = self.request_async(req)
+        self.request_async(abci.RequestFlush())
+        res = rr.wait(timeout)
+        if isinstance(res, abci.ResponseException):
+            raise ABCIClientError(res.error)
+        return res
+
+    def flush_sync(self, timeout: float = 10.0) -> None:
+        self.request_async(abci.RequestFlush()).wait(timeout)
+
+    def __getattr__(self, name: str):
+        if name.endswith("_sync") or name.endswith("_async"):
+            stem, _, kind = name.rpartition("_")
+            req_cls = {v: k for k, v in _METHODS.items()}.get(stem)
+            if req_cls is not None:
+                if kind == "sync":
+                    return lambda req=None: self.request_sync(req or req_cls())
+                return lambda req=None: self.request_async(req or req_cls())
+        raise AttributeError(name)
+
+
+def _dial(addr: str) -> socket.socket:
+    """addr: 'tcp://host:port' or 'unix:///path'."""
+    if addr.startswith("unix://"):
+        s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        s.connect(addr[len("unix://"):])
+        return s
+    if addr.startswith("tcp://"):
+        host, port = addr[len("tcp://"):].rsplit(":", 1)
+        return socket.create_connection((host, int(port)))
+    raise ValueError(f"unsupported ABCI address {addr!r}")
+
+
+def _read_frame(sock: socket.socket, buf: bytes) -> Tuple[Optional[bytes], bytes]:
+    """Read one uvarint-length-prefixed frame; returns (frame|None, leftover)."""
+    # parse varint
+    while True:
+        n = 0
+        shift = 0
+        i = 0
+        ok = False
+        for i, b in enumerate(buf):
+            n |= (b & 0x7F) << shift
+            shift += 7
+            if not (b & 0x80):
+                ok = True
+                break
+            if shift > 35:
+                raise OSError("frame length varint too long")
+        if ok and len(buf) >= i + 1 + n:
+            start = i + 1
+            return buf[start : start + n], buf[start + n :]
+        chunk = sock.recv(65536)
+        if not chunk:
+            return None, buf
+        buf += chunk

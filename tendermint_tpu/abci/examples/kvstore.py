@@ -1,0 +1,234 @@
+"""Example ABCI apps (ref: abci/example/kvstore/kvstore.go,
+persistent_kvstore.go, counter/counter.go).
+
+  * KVStoreApp           — in-memory merkleized key=value store
+  * PersistentKVStoreApp — + disk persistence and EndBlock validator-set
+    changes via 'val:<pubkey_b64>!<power>' txs
+  * CounterApp           — serial-number counter exercising CheckTx/DeliverTx
+    validation split
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import struct
+from typing import Dict, List, Optional
+
+from tendermint_tpu.abci import types as abci
+from tendermint_tpu.crypto import merkle
+
+VALIDATOR_TX_PREFIX = b"val:"
+
+
+class KVStoreApp(abci.Application):
+    """tx 'key=value' (or 'v' alone → v=v); app hash = merkle root over
+    sorted kv pairs + a size-dependent digest (reference uses iavl root;
+    deterministic digest is the contract, not the exact tree)."""
+
+    def __init__(self):
+        self.state: Dict[bytes, bytes] = {}
+        self.height = 0
+        self.size = 0
+
+    def _app_hash(self) -> bytes:
+        items = [k + b"=" + v for k, v in sorted(self.state.items())]
+        return merkle.hash_from_byte_slices(items)
+
+    def info(self, req: abci.RequestInfo) -> abci.ResponseInfo:
+        return abci.ResponseInfo(
+            data=json.dumps({"size": self.size}),
+            version="0.1.0",
+            last_block_height=self.height,
+            last_block_app_hash=self._app_hash() if self.height else b"",
+        )
+
+    def _apply(self, tx: bytes) -> None:
+        if b"=" in tx:
+            k, v = tx.split(b"=", 1)
+        else:
+            k = v = tx
+        self.state[k] = v
+        self.size += 1
+
+    def deliver_tx(self, req: abci.RequestDeliverTx) -> abci.ResponseDeliverTx:
+        self._apply(req.tx)
+        if b"=" in req.tx:
+            k, v = req.tx.split(b"=", 1)
+        else:
+            k = v = req.tx
+        return abci.ResponseDeliverTx(
+            code=abci.CODE_TYPE_OK,
+            tags=[
+                abci.KVPair(key=b"app.key", value=k),
+                abci.KVPair(key=b"app.creator", value=b"kvstore"),
+            ],
+        )
+
+    def check_tx(self, req: abci.RequestCheckTx) -> abci.ResponseCheckTx:
+        return abci.ResponseCheckTx(code=abci.CODE_TYPE_OK)
+
+    def commit(self, req: abci.RequestCommit) -> abci.ResponseCommit:
+        self.height += 1
+        return abci.ResponseCommit(data=self._app_hash())
+
+    def query(self, req: abci.RequestQuery) -> abci.ResponseQuery:
+        if req.path == "/store" or req.path == "":
+            value = self.state.get(req.data, b"")
+            return abci.ResponseQuery(
+                code=abci.CODE_TYPE_OK,
+                key=req.data,
+                value=value,
+                height=self.height,
+                log="exists" if value else "does not exist",
+            )
+        return abci.ResponseQuery(code=1, log=f"unknown path {req.path}")
+
+
+class PersistentKVStoreApp(KVStoreApp):
+    """KVStore + validator-set changes + height persistence
+    (ref persistent_kvstore.go:199: InitChain seeds validators, DeliverTx of
+    'val:PUBKEY!POWER' stages an update, EndBlock emits them)."""
+
+    def __init__(self, db=None):
+        super().__init__()
+        from tendermint_tpu.libs.db.kv import MemDB
+
+        self._db = db or MemDB()
+        self._val_updates: List[abci.ValidatorUpdate] = []
+        self.validators: Dict[bytes, int] = {}  # raw pubkey -> power
+        self._load()
+
+    def _load(self) -> None:
+        raw = self._db.get(b"kvstore:state")
+        if raw:
+            obj = json.loads(raw.decode())
+            self.height = obj["height"]
+            self.size = obj["size"]
+            self.state = {
+                base64.b64decode(k): base64.b64decode(v)
+                for k, v in obj["kv"].items()
+            }
+            self.validators = {
+                base64.b64decode(k): p for k, p in obj["vals"].items()
+            }
+
+    def _save(self) -> None:
+        obj = {
+            "height": self.height,
+            "size": self.size,
+            "kv": {
+                base64.b64encode(k).decode(): base64.b64encode(v).decode()
+                for k, v in self.state.items()
+            },
+            "vals": {
+                base64.b64encode(k).decode(): p for k, p in self.validators.items()
+            },
+        }
+        self._db.set_sync(b"kvstore:state", json.dumps(obj, sort_keys=True).encode())
+
+    def init_chain(self, req: abci.RequestInitChain) -> abci.ResponseInitChain:
+        for vu in req.validators:
+            self.validators[vu.pub_key] = vu.power
+        self._save()
+        return abci.ResponseInitChain()
+
+    def begin_block(self, req: abci.RequestBeginBlock) -> abci.ResponseBeginBlock:
+        self._val_updates = []
+        return abci.ResponseBeginBlock()
+
+    def deliver_tx(self, req: abci.RequestDeliverTx) -> abci.ResponseDeliverTx:
+        if req.tx.startswith(VALIDATOR_TX_PREFIX):
+            try:
+                body = req.tx[len(VALIDATOR_TX_PREFIX):]
+                pub_b64, power_s = body.split(b"!", 1)
+                pub = base64.b64decode(pub_b64)
+                power = int(power_s)
+            except Exception:
+                return abci.ResponseDeliverTx(code=1, log="bad validator tx")
+            self._val_updates.append(
+                abci.ValidatorUpdate(pub_key_type="ed25519", pub_key=pub, power=power)
+            )
+            if power == 0:
+                self.validators.pop(pub, None)
+            else:
+                self.validators[pub] = power
+            return abci.ResponseDeliverTx(code=abci.CODE_TYPE_OK)
+        return super().deliver_tx(req)
+
+    def end_block(self, req: abci.RequestEndBlock) -> abci.ResponseEndBlock:
+        return abci.ResponseEndBlock(validator_updates=list(self._val_updates))
+
+    def commit(self, req: abci.RequestCommit) -> abci.ResponseCommit:
+        res = super().commit(req)
+        self._save()
+        return res
+
+    def query(self, req: abci.RequestQuery) -> abci.ResponseQuery:
+        if req.path == "/val":
+            power = self.validators.get(req.data, 0)
+            return abci.ResponseQuery(
+                code=abci.CODE_TYPE_OK, key=req.data,
+                value=str(power).encode(), height=self.height,
+            )
+        return super().query(req)
+
+
+class CounterApp(abci.Application):
+    """Txs must be big-endian serial numbers when serial=true
+    (ref counter.go)."""
+
+    def __init__(self, serial: bool = True):
+        self.serial = serial
+        self.tx_count = 0
+        self.height = 0
+
+    def info(self, req: abci.RequestInfo) -> abci.ResponseInfo:
+        return abci.ResponseInfo(
+            data=json.dumps({"txs": self.tx_count}),
+            last_block_height=self.height,
+            last_block_app_hash=(
+                struct.pack(">Q", self.tx_count) if self.height else b""
+            ),
+        )
+
+    def set_option(self, req: abci.RequestSetOption) -> abci.ResponseSetOption:
+        if req.key == "serial":
+            self.serial = req.value == "on"
+        return abci.ResponseSetOption()
+
+    def _check(self, tx: bytes, expected: int) -> Optional[str]:
+        if not self.serial:
+            return None
+        if len(tx) > 8:
+            return f"tx too long: {len(tx)}"
+        val = int.from_bytes(tx, "big")
+        if val != expected:
+            return f"invalid nonce: got {val}, expected {expected}"
+        return None
+
+    def check_tx(self, req: abci.RequestCheckTx) -> abci.ResponseCheckTx:
+        err = self._check(req.tx, self.tx_count)
+        if err:
+            return abci.ResponseCheckTx(code=2, log=err)
+        return abci.ResponseCheckTx(code=abci.CODE_TYPE_OK)
+
+    def deliver_tx(self, req: abci.RequestDeliverTx) -> abci.ResponseDeliverTx:
+        err = self._check(req.tx, self.tx_count)
+        if err:
+            return abci.ResponseDeliverTx(code=2, log=err)
+        self.tx_count += 1
+        return abci.ResponseDeliverTx(code=abci.CODE_TYPE_OK)
+
+    def commit(self, req: abci.RequestCommit) -> abci.ResponseCommit:
+        self.height += 1
+        if self.tx_count == 0:
+            return abci.ResponseCommit()
+        return abci.ResponseCommit(data=struct.pack(">Q", self.tx_count))
+
+    def query(self, req: abci.RequestQuery) -> abci.ResponseQuery:
+        if req.path == "tx":
+            return abci.ResponseQuery(value=str(self.tx_count).encode())
+        if req.path == "hash":
+            return abci.ResponseQuery(value=str(self.height).encode())
+        return abci.ResponseQuery(log=f"invalid query path {req.path}")

@@ -1,0 +1,108 @@
+"""BlockStore — persisted blocks, parts, commits (ref: blockchain/store.go).
+
+Schema (all under one DB):
+  H:<height>      -> BlockMeta (block id + header)
+  P:<height>:<i>  -> Part i
+  C:<height>      -> LastCommit of block at height (commit FOR height-1... no:
+                     commit that committed block <height>, stored when known)
+  SC:<height>     -> SeenCommit (+2/3 precommits we saw locally)
+  BH              -> store height
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Optional
+
+from tendermint_tpu.encoding.codec import Reader, Writer
+from tendermint_tpu.libs.db.kv import DB
+from tendermint_tpu.types import Block, BlockID, Commit, Part, PartSet
+from tendermint_tpu.types.block import Header
+
+
+@dataclass
+class BlockMeta:
+    block_id: BlockID
+    header: Header
+
+    def marshal(self) -> bytes:
+        w = Writer()
+        self.block_id.encode(w)
+        self.header.encode(w)
+        return w.build()
+
+    @classmethod
+    def unmarshal(cls, data: bytes) -> "BlockMeta":
+        r = Reader(data)
+        return cls(block_id=BlockID.decode(r), header=Header.decode(r))
+
+
+class BlockStore:
+    def __init__(self, db: DB):
+        self._db = db
+        self._mtx = threading.RLock()
+        raw = db.get(b"BH")
+        self._height = int(raw.decode()) if raw else 0
+
+    def height(self) -> int:
+        with self._mtx:
+            return self._height
+
+    # loads ----------------------------------------------------------------
+    def load_block_meta(self, height: int) -> Optional[BlockMeta]:
+        raw = self._db.get(b"H:%d" % height)
+        return BlockMeta.unmarshal(raw) if raw else None
+
+    def load_block(self, height: int) -> Optional[Block]:
+        meta = self.load_block_meta(height)
+        if meta is None:
+            return None
+        parts = []
+        for i in range(meta.block_id.parts_header.total):
+            raw = self._db.get(b"P:%d:%d" % (height, i))
+            if raw is None:
+                return None
+            parts.append(Part.unmarshal(raw))
+        return Block.unmarshal(b"".join(p.bytes_ for p in parts))
+
+    def load_block_part(self, height: int, index: int) -> Optional[Part]:
+        raw = self._db.get(b"P:%d:%d" % (height, index))
+        return Part.unmarshal(raw) if raw else None
+
+    def load_block_commit(self, height: int) -> Optional[Commit]:
+        """The commit for block at `height`, from block height+1's LastCommit
+        (store.go LoadBlockCommit)."""
+        raw = self._db.get(b"C:%d" % height)
+        return Commit.unmarshal(raw) if raw else None
+
+    def load_seen_commit(self, height: int) -> Optional[Commit]:
+        raw = self._db.get(b"SC:%d" % height)
+        return Commit.unmarshal(raw) if raw else None
+
+    # saves ----------------------------------------------------------------
+    def save_block(self, block: Block, parts: PartSet, seen_commit: Commit) -> None:
+        """store.go SaveBlock: meta + parts + block's LastCommit (as commit of
+        height-1) + seen commit for this height."""
+        if block is None:
+            raise ValueError("BlockStore can only save a non-nil block")
+        height = block.height
+        with self._mtx:
+            if height != self._height + 1:
+                raise ValueError(
+                    f"BlockStore can only save contiguous blocks. "
+                    f"Wanted {self._height + 1}, got {height}"
+                )
+            if not parts.is_complete():
+                raise ValueError("BlockStore can only save complete part sets")
+            block_id = BlockID(hash=block.hash(), parts_header=parts.header())
+            batch = self._db.batch()
+            batch.set(b"H:%d" % height, BlockMeta(block_id, block.header).marshal())
+            for i in range(parts.total):
+                batch.set(b"P:%d:%d" % (height, i), parts.get_part(i).marshal())
+            if block.last_commit.is_commit():
+                batch.set(b"C:%d" % (height - 1), block.last_commit.marshal())
+            batch.set(b"SC:%d" % height, seen_commit.marshal())
+            batch.set(b"BH", str(height).encode())
+            batch.write()
+            self._height = height

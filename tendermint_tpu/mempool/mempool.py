@@ -1,0 +1,269 @@
+"""Mempool — pending txs validated by the app's CheckTx
+(ref: mempool/mempool.go, 980 LoC).
+
+Structure mirrors the reference: a concurrent list of good txs feeding both
+block proposals (reap_max_bytes_max_gas) and peer gossip (clist iteration with
+wait-for-next), an LRU-ish cache of seen txs, recheck of survivors after every
+commit, and an optional WAL of accepted txs.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+from tendermint_tpu.abci import types as abci
+from tendermint_tpu.crypto.hashing import tmhash
+from tendermint_tpu.libs.clist import CElement, CList
+from tendermint_tpu.state.services import Mempool as MempoolIface
+
+
+class MempoolError(Exception):
+    pass
+
+
+class TxInCacheError(MempoolError):
+    def __init__(self):
+        super().__init__("tx already exists in cache")
+
+
+class MempoolFullError(MempoolError):
+    def __init__(self, size: int, max_size: int):
+        super().__init__(f"mempool is full: {size} >= {max_size}")
+
+
+@dataclass
+class MempoolTx:
+    height: int  # height when tx was validated
+    gas_wanted: int
+    tx: bytes
+
+
+class TxCache:
+    """Bounded FIFO set of seen tx hashes (ref mempool.go txCache)."""
+
+    def __init__(self, size: int):
+        self._size = size
+        self._map: Dict[bytes, None] = {}
+        self._queue: collections.deque = collections.deque()
+        self._mtx = threading.Lock()
+
+    def push(self, tx: bytes) -> bool:
+        """False if already present."""
+        h = tmhash(tx)
+        with self._mtx:
+            if h in self._map:
+                return False
+            if len(self._queue) >= self._size:
+                old = self._queue.popleft()
+                self._map.pop(old, None)
+            self._queue.append(h)
+            self._map[h] = None
+            return True
+
+    def remove(self, tx: bytes) -> None:
+        h = tmhash(tx)
+        with self._mtx:
+            if h in self._map:
+                del self._map[h]
+                try:
+                    self._queue.remove(h)
+                except ValueError:
+                    pass
+
+    def reset(self) -> None:
+        with self._mtx:
+            self._map.clear()
+            self._queue.clear()
+
+
+class Mempool(MempoolIface):
+    def __init__(
+        self,
+        proxy_app,  # AppConnMempool
+        height: int = 0,
+        size: int = 5000,
+        cache_size: int = 10000,
+        max_tx_bytes: int = 1024 * 1024,
+        recheck: bool = True,
+        wal_group=None,
+        metrics=None,
+        logger=None,
+    ):
+        self._proxy = proxy_app
+        self._txs = CList()
+        self._tx_map: Dict[bytes, CElement] = {}  # tx hash -> element
+        self._height = height
+        self._rechecking = False
+        self._recheck_cursor: Optional[CElement] = None
+        self._recheck_end: Optional[CElement] = None
+        self._notified_txs_available = False
+        self._txs_available: Optional[threading.Event] = None
+        self._max_size = size
+        self._max_tx_bytes = max_tx_bytes
+        self._recheck_enabled = recheck
+        self.cache = TxCache(cache_size)
+        self._mtx = threading.RLock()  # the consensus Lock/Unlock boundary
+        self._wal = wal_group
+        self.metrics = metrics
+        import logging
+
+        self.logger = logger or logging.getLogger("tm.mempool")
+        self._proxy.set_response_callback(self._res_cb)
+
+    # locking (held by BlockExecutor.commit) -------------------------------
+    def lock(self) -> None:
+        self._mtx.acquire()
+
+    def unlock(self) -> None:
+        self._mtx.release()
+
+    # info -----------------------------------------------------------------
+    def size(self) -> int:
+        return len(self._txs)
+
+    def flush_app_conn(self) -> None:
+        self._proxy.flush_sync()
+
+    def flush(self) -> None:
+        """Drop all txs + cache (unsafe_flush_mempool RPC)."""
+        with self._mtx:
+            self.cache.reset()
+            el = self._txs.front()
+            while el is not None:
+                nxt = el.next()
+                self._txs.remove(el)
+                el = nxt
+            self._tx_map.clear()
+
+    def txs_front(self) -> Optional[CElement]:
+        return self._txs.front()
+
+    def txs_wait_chan(self):
+        return self._txs
+
+    # txs available notification -------------------------------------------
+    def enable_txs_available(self) -> None:
+        self._txs_available = threading.Event()
+
+    def txs_available(self) -> Optional[threading.Event]:
+        return self._txs_available
+
+    def _notify_txs_available(self) -> None:
+        if self.size() == 0:
+            return
+        if self._txs_available is not None and not self._notified_txs_available:
+            self._notified_txs_available = True
+            self._txs_available.set()
+
+    # CheckTx ---------------------------------------------------------------
+    def check_tx(self, tx: bytes, callback: Optional[Callable] = None) -> None:
+        """Queue tx for app validation; good txs enter the list
+        (mempool.go:301)."""
+        with self._mtx:
+            if self.size() >= self._max_size:
+                raise MempoolFullError(self.size(), self._max_size)
+            if len(tx) > self._max_tx_bytes:
+                raise MempoolError(f"tx too large ({len(tx)} bytes)")
+            if not self.cache.push(tx):
+                raise TxInCacheError()
+            if self._wal is not None:
+                self._wal.write(tx + b"\n")
+                self._wal.flush()
+            rr = self._proxy.check_tx_async(tx)
+            if callback is not None:
+                rr.set_callback(lambda req, res: callback(res))
+        self._proxy.flush_async()
+
+    def _res_cb(self, req, res) -> None:
+        if isinstance(res, abci.ResponseCheckTx):
+            if self._recheck_cursor is None:
+                self._res_cb_normal(req, res)
+            else:
+                self._res_cb_recheck(req, res)
+            if self.metrics is not None:
+                self.metrics.mempool_size.set(self.size())
+
+    def _res_cb_normal(self, req: abci.RequestCheckTx, res: abci.ResponseCheckTx) -> None:
+        tx = req.tx
+        if res.code == abci.CODE_TYPE_OK:
+            memtx = MempoolTx(height=self._height, gas_wanted=res.gas_wanted, tx=tx)
+            el = self._txs.push_back(memtx)
+            self._tx_map[tmhash(tx)] = el
+            self.logger.debug("added good tx size=%d", self.size())
+            self._notify_txs_available()
+        else:
+            self.logger.debug("rejected bad tx code=%d log=%s", res.code, res.log)
+            self.cache.remove(tx)
+
+    def _res_cb_recheck(self, req: abci.RequestCheckTx, res: abci.ResponseCheckTx) -> None:
+        cursor = self._recheck_cursor
+        memtx = cursor.value
+        if memtx.tx != req.tx:
+            self.logger.error("recheck transaction mismatch")
+        if res.code != abci.CODE_TYPE_OK:
+            # committed-state invalidated this tx
+            self._txs.remove(cursor)
+            self._tx_map.pop(tmhash(memtx.tx), None)
+            self.cache.remove(memtx.tx)
+        if cursor is self._recheck_end:
+            self._recheck_cursor = None
+            self._rechecking = False
+        else:
+            self._recheck_cursor = cursor.next()
+
+    # Reap ------------------------------------------------------------------
+    def reap_max_bytes_max_gas(self, max_bytes: int, max_gas: int) -> List[bytes]:
+        """Collect txs for a proposal under byte/gas budgets (mempool.go:471)."""
+        with self._mtx:
+            total_bytes = 0
+            total_gas = 0
+            out: List[bytes] = []
+            for memtx in self._txs:
+                sz = len(memtx.tx) + 8  # frame overhead allowance
+                if max_bytes > -1 and total_bytes + sz > max_bytes:
+                    break
+                if max_gas > -1 and total_gas + memtx.gas_wanted > max_gas:
+                    break
+                total_bytes += sz
+                total_gas += memtx.gas_wanted
+                out.append(memtx.tx)
+            return out
+
+    def reap_max_txs(self, n: int) -> List[bytes]:
+        with self._mtx:
+            out = []
+            for memtx in self._txs:
+                if len(out) >= n >= 0:
+                    break
+                out.append(memtx.tx)
+            return out
+
+    # Update (after commit; mempool locked by the executor) -----------------
+    def update(self, height: int, txs, pre_check=None, post_check=None) -> None:
+        """Remove committed txs, recheck the rest (mempool.go:531)."""
+        self._height = height
+        self._notified_txs_available = False
+        if self._txs_available is not None:
+            self._txs_available.clear()
+        for tx in txs:
+            tx = bytes(tx)
+            self.cache.push(tx)  # committed: keep in cache so re-adds fail
+            el = self._tx_map.pop(tmhash(tx), None)
+            if el is not None and not el.removed:
+                self._txs.remove(el)
+        if self._recheck_enabled and self.size() > 0:
+            self._recheck_txs()
+        else:
+            self._notify_txs_available()
+
+    def _recheck_txs(self) -> None:
+        self._recheck_cursor = self._txs.front()
+        self._recheck_end = self._txs.back()
+        self._rechecking = True
+        for memtx in self._txs:
+            self._proxy.check_tx_async(memtx.tx)
+        self._proxy.flush_async()
+        self._notify_txs_available()

@@ -76,7 +76,7 @@ def pack_commit_window(
             if item is None:
                 continue
             pub, msg, sig = item
-            if len(sig) != 64:
+            if len(sig) != 64 or len(pub) != 32:
                 continue
             coords.append((h, v))
             pubs_l.append(bytes(pub))

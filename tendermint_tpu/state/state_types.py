@@ -1,0 +1,193 @@
+"""State — everything needed to validate the next block
+(ref: state/state.go:51).
+
+MedianTime implements BFT time (state.go:167): the voting-power-weighted
+median of LastCommit timestamps, tamper-proof as long as <1/3 is byzantine.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+from typing import List, Optional, Tuple
+
+from tendermint_tpu.encoding.codec import Reader, Writer
+from tendermint_tpu.types import (
+    Block,
+    BlockID,
+    Commit,
+    ConsensusParams,
+    GenesisDoc,
+    Validator,
+    ValidatorSet,
+)
+from tendermint_tpu.types.block import Version
+
+
+@dataclass
+class State:
+    chain_id: str = ""
+    version: Version = field(default_factory=Version)
+
+    last_block_height: int = 0
+    last_block_total_tx: int = 0
+    last_block_id: BlockID = field(default_factory=BlockID)
+    last_block_time_ns: int = 0
+
+    next_validators: Optional[ValidatorSet] = None
+    validators: Optional[ValidatorSet] = None
+    last_validators: Optional[ValidatorSet] = None
+    last_height_validators_changed: int = 0
+
+    consensus_params: ConsensusParams = field(default_factory=ConsensusParams)
+    last_height_consensus_params_changed: int = 0
+
+    last_results_hash: bytes = b""
+    app_hash: bytes = b""
+
+    def copy(self) -> "State":
+        return State(
+            chain_id=self.chain_id,
+            version=self.version,
+            last_block_height=self.last_block_height,
+            last_block_total_tx=self.last_block_total_tx,
+            last_block_id=self.last_block_id,
+            last_block_time_ns=self.last_block_time_ns,
+            next_validators=self.next_validators.copy() if self.next_validators else None,
+            validators=self.validators.copy() if self.validators else None,
+            last_validators=self.last_validators.copy() if self.last_validators else None,
+            last_height_validators_changed=self.last_height_validators_changed,
+            consensus_params=self.consensus_params,
+            last_height_consensus_params_changed=self.last_height_consensus_params_changed,
+            last_results_hash=self.last_results_hash,
+            app_hash=self.app_hash,
+        )
+
+    def is_empty(self) -> bool:
+        return self.validators is None
+
+    # block construction ---------------------------------------------------
+    def make_block(
+        self,
+        height: int,
+        txs: List[bytes],
+        commit: Commit,
+        evidence: Optional[list] = None,
+        proposer_address: bytes = b"",
+        time_ns: Optional[int] = None,
+    ) -> Block:
+        """Build the next proposal block filled with state-derived header data
+        (ref state.go:132).  Block time = BFT MedianTime of the commit for
+        height > 1; proposer's clock at height 1."""
+        block = Block.make_block(height, txs, commit, evidence)
+        if height == 1:
+            t = self.last_block_time_ns  # genesis time (state.go:144)
+        else:
+            t = median_time(commit, self.last_validators)
+        h = block.header
+        h.version = self.version
+        h.chain_id = self.chain_id
+        h.time_ns = t
+        h.total_txs = self.last_block_total_tx + len(txs)
+        h.last_block_id = self.last_block_id
+        h.validators_hash = self.validators.hash()
+        h.next_validators_hash = self.next_validators.hash()
+        h.consensus_hash = self.consensus_params.hash()
+        h.app_hash = self.app_hash
+        h.last_results_hash = self.last_results_hash
+        h.proposer_address = proposer_address
+        return block
+
+    # codec ----------------------------------------------------------------
+    def marshal(self) -> bytes:
+        w = Writer()
+        w.string(self.chain_id)
+        self.version.encode(w)
+        w.svarint(self.last_block_height).svarint(self.last_block_total_tx)
+        self.last_block_id.encode(w)
+        w.fixed64(self.last_block_time_ns)
+        for vs in (self.next_validators, self.validators, self.last_validators):
+            if vs is None:
+                w.bool(False)
+            else:
+                w.bool(True)
+                vs.encode(w)
+        w.svarint(self.last_height_validators_changed)
+        self.consensus_params.encode(w)
+        w.svarint(self.last_height_consensus_params_changed)
+        w.bytes(self.last_results_hash).bytes(self.app_hash)
+        return w.build()
+
+    @classmethod
+    def unmarshal(cls, data: bytes) -> "State":
+        r = Reader(data)
+        chain_id = r.string()
+        version = Version.decode(r)
+        lbh = r.svarint()
+        lbt = r.svarint()
+        lbid = BlockID.decode(r)
+        lbtime = r.fixed64()
+        sets = []
+        for _ in range(3):
+            sets.append(ValidatorSet.decode(r) if r.bool() else None)
+        return cls(
+            chain_id=chain_id,
+            version=version,
+            last_block_height=lbh,
+            last_block_total_tx=lbt,
+            last_block_id=lbid,
+            last_block_time_ns=lbtime,
+            next_validators=sets[0],
+            validators=sets[1],
+            last_validators=sets[2],
+            last_height_validators_changed=r.svarint(),
+            consensus_params=ConsensusParams.decode(r),
+            last_height_consensus_params_changed=r.svarint(),
+            last_results_hash=r.bytes(),
+            app_hash=r.bytes(),
+        )
+
+
+def median_time(commit: Commit, validators: ValidatorSet) -> int:
+    """Voting-power-weighted median of commit vote timestamps (state.go:167).
+    Returns unix nanos."""
+    weighted: List[Tuple[int, int]] = []  # (time_ns, power)
+    total = 0
+    for i, pc in enumerate(commit.precommits):
+        if pc is None:
+            continue
+        _, val = validators.get_by_index(i)
+        if val is None:
+            continue
+        weighted.append((pc.timestamp_ns, val.voting_power))
+        total += val.voting_power
+    if not weighted:
+        return 0
+    weighted.sort()
+    half = total // 2
+    acc = 0
+    for t, p in weighted:
+        acc += p
+        if acc > half:
+            return t
+    return weighted[-1][0]
+
+
+def state_from_genesis(genesis: GenesisDoc) -> State:
+    """Bootstrap state at height 0 (ref state.go MakeGenesisState)."""
+    genesis.validate_and_complete()
+    vals = [Validator(v.pub_key, v.power) for v in genesis.validators]
+    vs = ValidatorSet(vals) if vals else None
+    next_vs = vs.copy_increment_accum(1) if vs else None
+    return State(
+        chain_id=genesis.chain_id,
+        last_block_height=0,
+        last_block_id=BlockID(),
+        last_block_time_ns=genesis.genesis_time_ns,
+        validators=vs,
+        next_validators=next_vs,
+        last_validators=ValidatorSet(),
+        last_height_validators_changed=1,
+        consensus_params=genesis.consensus_params,
+        last_height_consensus_params_changed=1,
+        app_hash=genesis.app_hash,
+    )

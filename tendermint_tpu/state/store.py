@@ -1,0 +1,186 @@
+"""State persistence (ref: state/store.go:29-300).
+
+Keys mirror the reference schema: the State snapshot under 'stateKey',
+per-height validator sets ('validatorsKey:<H>'), per-height consensus params
+('consensusParamsKey:<H>'), and per-height ABCIResponses ('abciResponsesKey:<H>').
+Validator/params records are only written at change heights; lookups chase the
+'last changed' pointer exactly like the reference.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from tendermint_tpu.abci import types as abci
+from tendermint_tpu.encoding.codec import Reader, Writer
+from tendermint_tpu.libs.db.kv import DB
+from tendermint_tpu.state.state_types import State, state_from_genesis
+from tendermint_tpu.types import ConsensusParams, GenesisDoc, ValidatorSet
+
+_STATE_KEY = b"stateKey"
+
+
+def _validators_key(height: int) -> bytes:
+    return b"validatorsKey:%d" % height
+
+
+def _params_key(height: int) -> bytes:
+    return b"consensusParamsKey:%d" % height
+
+
+def _abci_responses_key(height: int) -> bytes:
+    return b"abciResponsesKey:%d" % height
+
+
+class NoValSetForHeightError(Exception):
+    pass
+
+
+class NoABCIResponsesForHeightError(Exception):
+    pass
+
+
+@dataclass
+class ABCIResponses:
+    """Responses from executing a block, persisted for replay/indexing
+    (ref state.go ABCIResponses)."""
+
+    deliver_tx: List[abci.ResponseDeliverTx] = field(default_factory=list)
+    end_block: Optional[abci.ResponseEndBlock] = None
+    begin_block: Optional[abci.ResponseBeginBlock] = None
+
+    def results_hash(self) -> bytes:
+        from tendermint_tpu.types import ABCIResults
+
+        return ABCIResults.from_deliver_txs(self.deliver_tx).hash()
+
+    def marshal(self) -> bytes:
+        return abci.msg_to_json(
+            [self.deliver_tx, self.end_block, self.begin_block]
+        )
+
+    @classmethod
+    def unmarshal(cls, data: bytes) -> "ABCIResponses":
+        dtxs, eb, bb = abci.msg_from_json(data)
+        return cls(deliver_tx=dtxs, end_block=eb, begin_block=bb)
+
+
+# ---------------------------------------------------------------------------
+# load/save
+# ---------------------------------------------------------------------------
+
+
+def load_state(db: DB) -> Optional[State]:
+    raw = db.get(_STATE_KEY)
+    return State.unmarshal(raw) if raw else None
+
+
+def save_state(db: DB, state: State) -> None:
+    """Persist the snapshot + the next height's validators/params records
+    (ref store.go saveState)."""
+    next_height = state.last_block_height + 1
+    if next_height == 1:
+        # bootstrap: genesis validators recorded for height 1 (the params
+        # record for height 1 is written by the unconditional save below)
+        save_validators_info(db, next_height, state.last_height_validators_changed,
+                             state.validators)
+    save_validators_info(db, next_height + 1, state.last_height_validators_changed,
+                         state.next_validators)
+    save_consensus_params_info(
+        db, next_height, state.last_height_consensus_params_changed,
+        state.consensus_params,
+    )
+    db.set_sync(_STATE_KEY, state.marshal())
+
+
+def load_state_from_db_or_genesis(db: DB, genesis: GenesisDoc) -> State:
+    state = load_state(db)
+    if state is None or state.is_empty():
+        state = state_from_genesis(genesis)
+    return state
+
+
+# validators per height ------------------------------------------------------
+
+
+def save_validators_info(
+    db: DB, height: int, last_changed: int, vals: Optional[ValidatorSet]
+) -> None:
+    """Write a record at `height`; the full set is stored only at change
+    heights, otherwise just the pointer (ref store.go:149-170)."""
+    w = Writer()
+    w.svarint(last_changed)
+    if height == last_changed and vals is not None:
+        w.bool(True)
+        vals.encode(w)
+    else:
+        w.bool(False)
+    db.set(_validators_key(height), w.build())
+
+
+def load_validators(db: DB, height: int) -> ValidatorSet:
+    raw = db.get(_validators_key(height))
+    if raw is None:
+        raise NoValSetForHeightError(height)
+    r = Reader(raw)
+    last_changed = r.svarint()
+    if r.bool():
+        return ValidatorSet.decode(r)
+    # chase the pointer to the change height
+    raw2 = db.get(_validators_key(last_changed))
+    if raw2 is None:
+        raise NoValSetForHeightError(height)
+    r2 = Reader(raw2)
+    r2.svarint()
+    if not r2.bool():
+        raise NoValSetForHeightError(height)
+    return ValidatorSet.decode(r2)
+
+
+# consensus params per height ------------------------------------------------
+
+
+def save_consensus_params_info(
+    db: DB, height: int, last_changed: int, params: ConsensusParams
+) -> None:
+    w = Writer()
+    w.svarint(last_changed)
+    if height == last_changed:
+        w.bool(True)
+        params.encode(w)
+    else:
+        w.bool(False)
+    db.set(_params_key(height), w.build())
+
+
+def load_consensus_params(db: DB, height: int) -> ConsensusParams:
+    raw = db.get(_params_key(height))
+    if raw is None:
+        raise NoValSetForHeightError(f"params @ {height}")
+    r = Reader(raw)
+    last_changed = r.svarint()
+    if r.bool():
+        return ConsensusParams.decode(r)
+    raw2 = db.get(_params_key(last_changed))
+    if raw2 is None:
+        raise NoValSetForHeightError(f"params @ {height}")
+    r2 = Reader(raw2)
+    r2.svarint()
+    if not r2.bool():
+        raise NoValSetForHeightError(f"params @ {height}")
+    return ConsensusParams.decode(r2)
+
+
+# ABCI responses -------------------------------------------------------------
+
+
+def save_abci_responses(db: DB, height: int, responses: ABCIResponses) -> None:
+    db.set(_abci_responses_key(height), responses.marshal())
+
+
+def load_abci_responses(db: DB, height: int) -> ABCIResponses:
+    raw = db.get(_abci_responses_key(height))
+    if raw is None:
+        raise NoABCIResponsesForHeightError(height)
+    return ABCIResponses.unmarshal(raw)

@@ -1,0 +1,132 @@
+"""Block validation against state (ref: state/validation.go:16-166).
+
+The LastCommit check at the heart of this file (validation.go:102) is the
+SIGNATURE HOT SPOT — it goes through ValidatorSet.verify_commit, i.e. one
+batched device dispatch per block instead of the reference's serial loop.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from tendermint_tpu.libs.db.kv import DB
+from tendermint_tpu.state import store
+from tendermint_tpu.state.state_types import State, median_time
+from tendermint_tpu.types import Block, DuplicateVoteEvidence
+
+MAX_EVIDENCE_PER_BLOCK = 50
+
+
+class BlockValidationError(Exception):
+    pass
+
+
+class EvidenceInvalidError(Exception):
+    pass
+
+
+def validate_block(state_db: DB, state: State, block: Block, verifier=None) -> None:
+    block.validate_basic()
+
+    # basic info
+    if block.header.version != state.version:
+        raise BlockValidationError(
+            f"wrong Version: expected {state.version}, got {block.header.version}"
+        )
+    if block.header.chain_id != state.chain_id:
+        raise BlockValidationError(
+            f"wrong ChainID: expected {state.chain_id}, got {block.header.chain_id}"
+        )
+    if block.header.height != state.last_block_height + 1:
+        raise BlockValidationError(
+            f"wrong Height: expected {state.last_block_height + 1}, "
+            f"got {block.header.height}"
+        )
+
+    # prev block info
+    if block.header.last_block_id != state.last_block_id:
+        raise BlockValidationError("wrong LastBlockID")
+    new_txs = len(block.data.txs)
+    if block.header.total_txs != state.last_block_total_tx + new_txs:
+        raise BlockValidationError(
+            f"wrong TotalTxs: expected {state.last_block_total_tx + new_txs}, "
+            f"got {block.header.total_txs}"
+        )
+
+    # app info from the previous block
+    if block.header.app_hash != state.app_hash:
+        raise BlockValidationError("wrong AppHash")
+    if block.header.consensus_hash != state.consensus_params.hash():
+        raise BlockValidationError("wrong ConsensusHash")
+    if block.header.last_results_hash != state.last_results_hash:
+        raise BlockValidationError("wrong LastResultsHash")
+    if block.header.validators_hash != state.validators.hash():
+        raise BlockValidationError("wrong ValidatorsHash")
+    if block.header.next_validators_hash != state.next_validators.hash():
+        raise BlockValidationError("wrong NextValidatorsHash")
+
+    # LastCommit — ★ the batched signature verification boundary
+    if block.header.height == 1:
+        if len(block.last_commit.precommits) != 0:
+            raise BlockValidationError("block at height 1 can't have LastCommit")
+    else:
+        if len(block.last_commit.precommits) != state.last_validators.size:
+            raise BlockValidationError(
+                f"invalid commit size: expected {state.last_validators.size}, "
+                f"got {len(block.last_commit.precommits)}"
+            )
+        state.last_validators.verify_commit(
+            state.chain_id, state.last_block_id, block.header.height - 1,
+            block.last_commit, verifier=verifier,
+        )
+
+    # block time: BFT median of LastCommit (validation.go:117-141)
+    if block.header.height > 1:
+        if block.header.time_ns <= state.last_block_time_ns:
+            raise BlockValidationError("block time not greater than last block time")
+        want = median_time(block.last_commit, state.last_validators)
+        if block.header.time_ns != want:
+            raise BlockValidationError(
+                f"invalid block time: expected {want}, got {block.header.time_ns}"
+            )
+    elif block.header.height == 1:
+        if block.header.time_ns != state.last_block_time_ns:
+            raise BlockValidationError("block time != genesis time")
+
+    # evidence
+    if len(block.evidence.evidence) > MAX_EVIDENCE_PER_BLOCK:
+        raise BlockValidationError("too much evidence")
+    for ev in block.evidence.evidence:
+        try:
+            verify_evidence(state_db, state, ev)
+        except Exception as e:
+            raise EvidenceInvalidError(str(e)) from e
+
+    # proposer must be a known validator
+    if (
+        len(block.header.proposer_address) != 20
+        or not state.validators.has_address(block.header.proposer_address)
+    ):
+        raise BlockValidationError(
+            f"ProposerAddress {block.header.proposer_address.hex()} is not a validator"
+        )
+
+
+def verify_evidence(state_db: DB, state: State, ev: DuplicateVoteEvidence) -> None:
+    """validation.go:167: recent enough, from a then-validator, internally
+    consistent, properly signed."""
+    height = state.last_block_height
+    ev_height = ev.height
+    max_age = state.consensus_params.evidence.max_age
+    if height - ev_height > max_age:
+        raise EvidenceInvalidError(
+            f"evidence from height {ev_height} is too old (now {height}, max age {max_age})"
+        )
+
+    valset = store.load_validators(state_db, ev_height)
+    _, val = valset.get_by_address(ev.address)
+    if val is None:
+        raise EvidenceInvalidError(
+            f"address {ev.address.hex()} was not a validator at height {ev_height}"
+        )
+    ev.verify(state.chain_id)

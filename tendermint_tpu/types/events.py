@@ -1,0 +1,141 @@
+"""EventBus + event types (ref: types/event_bus.go, types/events.go).
+
+The EventBus bridges internal components to subscribers (RPC websocket,
+tx indexer) through libs.pubsub with tag-based queries.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+from tendermint_tpu.libs.pubsub import Query, Server, Subscription
+from tendermint_tpu.libs.service import BaseService
+
+# event types (events.go)
+EVENT_NEW_BLOCK = "NewBlock"
+EVENT_NEW_BLOCK_HEADER = "NewBlockHeader"
+EVENT_TX = "Tx"
+EVENT_NEW_ROUND_STEP = "NewRoundStep"
+EVENT_NEW_ROUND = "NewRound"
+EVENT_COMPLETE_PROPOSAL = "CompleteProposal"
+EVENT_POLKA = "Polka"
+EVENT_UNLOCK = "Unlock"
+EVENT_LOCK = "Lock"
+EVENT_RELOCK = "Relock"
+EVENT_TIMEOUT_PROPOSE = "TimeoutPropose"
+EVENT_TIMEOUT_WAIT = "TimeoutWait"
+EVENT_VOTE = "Vote"
+EVENT_PROPOSAL_HEARTBEAT = "ProposalHeartbeat"
+EVENT_VALID_BLOCK = "ValidBlock"
+
+# tag keys (events.go: EventTypeKey, TxHashKey, TxHeightKey)
+EVENT_TYPE_KEY = "tm.event"
+TX_HASH_KEY = "tx.hash"
+TX_HEIGHT_KEY = "tx.height"
+
+
+def query_for_event(event_type: str) -> str:
+    return f"{EVENT_TYPE_KEY} = '{event_type}'"
+
+
+@dataclass
+class EventDataNewBlock:
+    block: Any
+    result_begin_block: Any = None
+    result_end_block: Any = None
+
+
+@dataclass
+class EventDataNewBlockHeader:
+    header: Any
+
+
+@dataclass
+class EventDataTx:
+    height: int
+    index: int
+    tx: bytes
+    result: Any
+
+
+@dataclass
+class EventDataRoundState:
+    height: int
+    round: int
+    step: str
+    round_state: Any = None
+
+
+@dataclass
+class EventDataVote:
+    vote: Any
+
+
+@dataclass
+class EventDataValidatorSetUpdates:
+    validator_updates: list = field(default_factory=list)
+
+
+class EventBus(BaseService):
+    """event_bus.go:23 — typed publish helpers over one pubsub server."""
+
+    def __init__(self, buffer: int = 1024):
+        super().__init__("EventBus")
+        self._server = Server(buffer=buffer)
+
+    def subscribe(self, client_id: str, query: str, maxsize: int = 0) -> Subscription:
+        return self._server.subscribe(client_id, query, maxsize)
+
+    def unsubscribe(self, client_id: str, query: str) -> None:
+        self._server.unsubscribe(client_id, query)
+
+    def unsubscribe_all(self, client_id: str) -> None:
+        self._server.unsubscribe_all(client_id)
+
+    def _publish(self, event_type: str, data: Any, extra_tags: Optional[Dict[str, str]] = None) -> None:
+        tags = {EVENT_TYPE_KEY: event_type}
+        if extra_tags:
+            tags.update(extra_tags)
+        self._server.publish(data, tags)
+
+    # typed helpers ---------------------------------------------------------
+    def publish_event_new_block(self, block, abci_responses=None) -> None:
+        self._publish(
+            EVENT_NEW_BLOCK,
+            EventDataNewBlock(
+                block=block,
+                result_begin_block=getattr(abci_responses, "begin_block", None),
+                result_end_block=getattr(abci_responses, "end_block", None),
+            ),
+        )
+
+    def publish_event_new_block_header(self, header) -> None:
+        self._publish(EVENT_NEW_BLOCK_HEADER, EventDataNewBlockHeader(header=header))
+
+    def publish_event_tx(self, height: int, index: int, tx: bytes, result) -> None:
+        import hashlib
+
+        tx_hash = hashlib.sha256(tx).digest().hex().upper()
+        # deliver-tx tags become queryable (event_bus.go PublishEventTx)
+        extra = {TX_HASH_KEY: tx_hash, TX_HEIGHT_KEY: str(height)}
+        for kv in getattr(result, "tags", None) or []:
+            try:
+                extra[kv.key.decode()] = kv.value.decode()
+            except UnicodeDecodeError:
+                pass
+        self._publish(EVENT_TX, EventDataTx(height=height, index=index, tx=tx, result=result), extra)
+
+    def publish_event_vote(self, vote) -> None:
+        self._publish(EVENT_VOTE, EventDataVote(vote=vote))
+
+    def publish_event_round_state(self, event_type: str, height: int, round: int, step: str, rs=None) -> None:
+        self._publish(
+            event_type,
+            EventDataRoundState(height=height, round=round, step=step, round_state=rs),
+        )
+
+    def publish_event_validator_set_updates(self, updates) -> None:
+        self._publish(
+            "ValidatorSetUpdates", EventDataValidatorSetUpdates(validator_updates=updates)
+        )

@@ -1,0 +1,238 @@
+"""Mempool (CheckTx/reap/update/recheck/cache), evidence pool, FilePV
+double-sign protection."""
+
+import os
+import threading
+
+import pytest
+
+from tendermint_tpu.abci import types as abci
+from tendermint_tpu.abci.examples.kvstore import CounterApp, KVStoreApp
+from tendermint_tpu.crypto.keys import PrivKeyEd25519
+from tendermint_tpu.libs.db.kv import MemDB
+from tendermint_tpu.mempool.mempool import (
+    Mempool,
+    MempoolFullError,
+    TxInCacheError,
+)
+from tendermint_tpu.privval.file_pv import DoubleSignError, FilePV
+from tendermint_tpu.proxy.app_conn import LocalClientCreator, MultiAppConn
+from tendermint_tpu.types import (
+    BlockID,
+    MockPV,
+    PartSetHeader,
+    Proposal,
+    SignedMsgType,
+    Vote,
+)
+
+CHAIN_ID = "mp-chain"
+
+
+def make_mempool(app=None):
+    conn = MultiAppConn(LocalClientCreator(app or KVStoreApp()))
+    conn.start()
+    return Mempool(conn.mempool), conn
+
+
+class TestMempool:
+    def test_check_tx_and_reap(self):
+        mp, _ = make_mempool()
+        results = []
+        for i in range(5):
+            mp.check_tx(b"k%d=v%d" % (i, i), callback=results.append)
+        assert mp.size() == 5
+        assert all(r.code == 0 for r in results)
+        txs = mp.reap_max_bytes_max_gas(-1, -1)
+        assert len(txs) == 5
+        # byte budget cuts the reap
+        some = mp.reap_max_bytes_max_gas(2 * (8 + 8), -1)
+        assert len(some) == 2
+
+    def test_cache_rejects_duplicates(self):
+        mp, _ = make_mempool()
+        mp.check_tx(b"dup=1")
+        with pytest.raises(TxInCacheError):
+            mp.check_tx(b"dup=1")
+        assert mp.size() == 1
+
+    def test_full_mempool(self):
+        conn = MultiAppConn(LocalClientCreator(KVStoreApp()))
+        conn.start()
+        mp = Mempool(conn.mempool, size=2)
+        mp.check_tx(b"a=1")
+        mp.check_tx(b"b=2")
+        with pytest.raises(MempoolFullError):
+            mp.check_tx(b"c=3")
+
+    def test_update_removes_committed(self):
+        mp, _ = make_mempool()
+        for i in range(4):
+            mp.check_tx(b"u%d=%d" % (i, i))
+        mp.lock()
+        try:
+            mp.update(1, [b"u0=0", b"u2=2"])
+        finally:
+            mp.unlock()
+        left = mp.reap_max_bytes_max_gas(-1, -1)
+        assert left == [b"u1=1", b"u3=3"]
+        # committed tx cannot re-enter (still cached)
+        with pytest.raises(TxInCacheError):
+            mp.check_tx(b"u0=0")
+
+    def test_recheck_drops_invalidated(self):
+        """CounterApp with serial nonces: after committing nonce 0-1, the
+        stale nonce-1 tx left in the pool must be dropped by recheck."""
+        app = CounterApp(serial=False)  # accept any nonce into the pool
+        mp, conn = make_mempool(app)
+        for tx in (b"\x00", b"\x01", b"\x02", b"\x05"):
+            mp.check_tx(tx)
+        assert mp.size() == 4
+        # app commits nonces 0-1; strict serial checking resumes
+        app.serial = True
+        app.tx_count = 2
+        mp.lock()
+        try:
+            mp.update(1, [b"\x00", b"\x01"])
+        finally:
+            mp.unlock()
+        mp.flush_app_conn()
+        # recheck keeps \x02 (the next valid nonce) and drops stale \x05
+        assert mp.reap_max_bytes_max_gas(-1, -1) == [b"\x02"]
+
+    def test_txs_available_notification(self):
+        mp, _ = make_mempool()
+        mp.enable_txs_available()
+        ev = mp.txs_available()
+        assert not ev.is_set()
+        mp.check_tx(b"n=1")
+        assert ev.wait(timeout=1)
+
+
+class TestEvidencePool:
+    def test_add_verify_commit_age(self):
+        from tendermint_tpu.evidence.pool import EvidencePool
+        from tendermint_tpu.state import store
+        from tendermint_tpu.state.state_types import state_from_genesis
+        from tests.test_state import make_genesis
+
+        doc, pvs = make_genesis(2)
+        st = state_from_genesis(doc)
+        st.last_block_height = 5
+        state_db = MemDB()
+        store.save_validators_info(state_db, 5, 5, st.validators)
+        pool = EvidencePool(state_db, MemDB(), st)
+
+        def mkvote(bid_tag):
+            val = st.validators.validators[0]
+            pv = {p.get_pub_key().address(): p for p in pvs}[val.address]
+            v = Vote(
+                SignedMsgType.PREVOTE, 5, 0, 123,
+                BlockID(hash=bid_tag * 32, parts_header=PartSetHeader(1, b"p" * 32)),
+                val.address, 0,
+            )
+            return pv.sign_vote(st.chain_id, v)
+
+        from tendermint_tpu.types import DuplicateVoteEvidence
+
+        ev = DuplicateVoteEvidence(
+            pub_key=st.validators.validators[0].pub_key,
+            vote_a=mkvote(b"a"),
+            vote_b=mkvote(b"b"),
+        )
+        pool.add_evidence(ev)
+        assert len(pool.pending_evidence()) == 1
+        pool.add_evidence(ev)  # duplicate ignored
+        assert len(pool.pending_evidence()) == 1
+
+        # commit it via a block
+        class B:
+            height = 6
+
+            class evidence:
+                evidence = [ev]
+
+        pool.update(B, st)
+        assert pool.is_committed(ev)
+        assert len(pool.pending_evidence()) == 0
+
+    def test_invalid_evidence_rejected(self):
+        from tendermint_tpu.evidence.pool import EvidencePool
+        from tendermint_tpu.state import store
+        from tendermint_tpu.state.state_types import state_from_genesis
+        from tests.test_state import make_genesis
+        from tendermint_tpu.types import DuplicateVoteEvidence
+
+        doc, pvs = make_genesis(1)
+        st = state_from_genesis(doc)
+        st.last_block_height = 3
+        state_db = MemDB()
+        store.save_validators_info(state_db, 3, 3, st.validators)
+        pool = EvidencePool(state_db, MemDB(), st)
+        # same-block votes: not evidence
+        val = st.validators.validators[0]
+        pv = pvs[0]
+        bid = BlockID(hash=b"q" * 32, parts_header=PartSetHeader(1, b"p" * 32))
+        v = pv.sign_vote(st.chain_id, Vote(SignedMsgType.PREVOTE, 3, 0, 1, bid, val.address, 0))
+        with pytest.raises(Exception):
+            pool.add_evidence(DuplicateVoteEvidence(val.pub_key, v, v))
+
+
+class TestFilePV:
+    def _vote(self, height, round, vtype=SignedMsgType.PREVOTE, ts=1000, tag=b"h"):
+        return Vote(
+            vote_type=vtype, height=height, round=round, timestamp_ns=ts,
+            block_id=BlockID(hash=tag * 32, parts_header=PartSetHeader(1, b"p" * 32)),
+            validator_address=b"\x00" * 20, validator_index=0,
+        )
+
+    def test_persist_and_reload(self, tmp_path):
+        path = str(tmp_path / "pv.json")
+        pv = FilePV.generate(path, b"\x09" * 32)
+        v = pv.sign_vote(CHAIN_ID, self._vote(3, 0))
+        assert v.signature
+        pv2 = FilePV.load(path)
+        assert pv2.get_pub_key().equals(pv.get_pub_key())
+        assert pv2.last_height == 3
+
+    def test_height_regression_refused(self, tmp_path):
+        pv = FilePV.generate(str(tmp_path / "pv.json"), b"\x09" * 32)
+        pv.sign_vote(CHAIN_ID, self._vote(5, 2))
+        with pytest.raises(DoubleSignError, match="height regression"):
+            pv.sign_vote(CHAIN_ID, self._vote(4, 0))
+        with pytest.raises(DoubleSignError, match="round regression"):
+            pv.sign_vote(CHAIN_ID, self._vote(5, 1))
+
+    def test_step_regression_refused(self, tmp_path):
+        pv = FilePV.generate(str(tmp_path / "pv.json"), b"\x09" * 32)
+        pv.sign_vote(CHAIN_ID, self._vote(5, 0, SignedMsgType.PRECOMMIT))
+        with pytest.raises(DoubleSignError, match="step regression"):
+            pv.sign_vote(CHAIN_ID, self._vote(5, 0, SignedMsgType.PREVOTE))
+
+    def test_conflicting_same_hrs_refused(self, tmp_path):
+        pv = FilePV.generate(str(tmp_path / "pv.json"), b"\x09" * 32)
+        pv.sign_vote(CHAIN_ID, self._vote(5, 0, tag=b"a"))
+        with pytest.raises(DoubleSignError, match="conflicting"):
+            pv.sign_vote(CHAIN_ID, self._vote(5, 0, tag=b"b"))
+
+    def test_timestamp_only_resign_reuses_signature(self, tmp_path):
+        pv = FilePV.generate(str(tmp_path / "pv.json"), b"\x09" * 32)
+        v1 = pv.sign_vote(CHAIN_ID, self._vote(5, 0, ts=1000))
+        v2 = pv.sign_vote(CHAIN_ID, self._vote(5, 0, ts=2000))
+        assert v2.signature == v1.signature
+        assert v2.timestamp_ns == 1000  # original timestamp restored
+        # and it still verifies
+        v2.verify(CHAIN_ID, pv.get_pub_key()) if v2.validator_address == pv.get_pub_key().address() else \
+            pv.get_pub_key().verify_bytes(v2.sign_bytes(CHAIN_ID), v2.signature)
+
+    def test_proposal_sign(self, tmp_path):
+        pv = FilePV.generate(str(tmp_path / "pv.json"), b"\x09" * 32)
+        p = Proposal(
+            height=7, round=0, timestamp_ns=5555,
+            block_id=BlockID(hash=b"x" * 32, parts_header=PartSetHeader(2, b"p" * 32)),
+        )
+        sp = pv.sign_proposal(CHAIN_ID, p)
+        assert pv.get_pub_key().verify_bytes(sp.sign_bytes(CHAIN_ID), sp.signature)
+        # exact re-sign returns the same signature
+        sp2 = pv.sign_proposal(CHAIN_ID, p)
+        assert sp2.signature == sp.signature

@@ -1,0 +1,313 @@
+"""ABCI apps/clients, state store, BlockExecutor — including a mini chain
+driven end-to-end through apply_block on the kvstore app."""
+
+import threading
+
+import pytest
+
+from tendermint_tpu.abci import types as abci
+from tendermint_tpu.abci.client import LocalClient, SocketClient
+from tendermint_tpu.abci.examples.kvstore import (
+    CounterApp,
+    KVStoreApp,
+    PersistentKVStoreApp,
+)
+from tendermint_tpu.abci.server import ABCIServer
+from tendermint_tpu.blockchain.store import BlockStore
+from tendermint_tpu.crypto.keys import PrivKeyEd25519
+from tendermint_tpu.libs.db.kv import MemDB
+from tendermint_tpu.proxy.app_conn import LocalClientCreator, MultiAppConn
+from tendermint_tpu.state import store
+from tendermint_tpu.state.execution import BlockExecutor, update_state
+from tendermint_tpu.state.state_types import State, median_time, state_from_genesis
+from tendermint_tpu.state.validation import BlockValidationError
+from tendermint_tpu.types import (
+    BlockID,
+    Commit,
+    GenesisDoc,
+    GenesisValidator,
+    MockPV,
+    SignedMsgType,
+    Validator,
+    ValidatorSet,
+    Vote,
+)
+from tendermint_tpu.types.events import EventBus
+
+CHAIN_ID = "exec-chain"
+
+
+def make_genesis(n=1, power=10):
+    pvs = [MockPV(PrivKeyEd25519.generate(bytes([i + 1]) * 32)) for i in range(n)]
+    doc = GenesisDoc(
+        chain_id=CHAIN_ID,
+        genesis_time_ns=1_700_000_000_000_000_000,
+        validators=[GenesisValidator(pv.get_pub_key(), power) for pv in pvs],
+    )
+    doc.validate_and_complete()
+    return doc, pvs
+
+
+def commit_for(state: State, block, pvs, block_id):
+    """Sign a commit for `block` by all pvs."""
+    vs = state.validators
+    by_addr = {pv.get_pub_key().address(): pv for pv in pvs}
+    precommits = []
+    for i, val in enumerate(vs.validators):
+        pv = by_addr[val.address]
+        vote = Vote(
+            vote_type=SignedMsgType.PRECOMMIT,
+            height=block.height,
+            round=0,
+            timestamp_ns=block.header.time_ns + 1_000_000,
+            block_id=block_id,
+            validator_address=val.address,
+            validator_index=i,
+        )
+        precommits.append(pv.sign_vote(CHAIN_ID, vote))
+    return Commit(block_id=block_id, precommits=precommits)
+
+
+class TestABCIClients:
+    def test_local_client_kvstore(self):
+        client = LocalClient(KVStoreApp())
+        client.start()
+        res = client.request_sync(abci.RequestDeliverTx(tx=b"name=satoshi"))
+        assert res.code == abci.CODE_TYPE_OK
+        client.request_sync(abci.RequestCommit())
+        q = client.request_sync(abci.RequestQuery(data=b"name", path="/store"))
+        assert q.value == b"satoshi"
+
+    def test_socket_client_server_roundtrip(self):
+        app = KVStoreApp()
+        srv = ABCIServer("tcp://127.0.0.1:0", app)
+        srv.start()
+        try:
+            port = srv.bound_port
+            cli = SocketClient(f"tcp://127.0.0.1:{port}")
+            cli.start()
+            try:
+                echo = cli.request_sync(abci.RequestEcho(message="hi"))
+                assert echo.message == "hi"
+                res = cli.request_sync(abci.RequestDeliverTx(tx=b"k=v"))
+                assert res.code == abci.CODE_TYPE_OK
+                cli.request_sync(abci.RequestCommit())
+                q = cli.request_sync(abci.RequestQuery(data=b"k"))
+                assert q.value == b"v"
+                # async pipeline + flush
+                for i in range(20):
+                    cli.request_async(abci.RequestDeliverTx(tx=b"x%d=%d" % (i, i)))
+                cli.flush_sync()
+                assert app.size == 21  # 1 (k=v) + 20 pipelined
+            finally:
+                cli.stop()
+        finally:
+            srv.stop()
+
+    def test_counter_serial_nonce(self):
+        app = CounterApp(serial=True)
+        c = LocalClient(app)
+        c.start()
+        assert c.request_sync(abci.RequestDeliverTx(tx=b"\x00")).code == 0
+        bad = c.request_sync(abci.RequestDeliverTx(tx=b"\x05"))
+        assert bad.code == 2 and "nonce" in bad.log
+        assert c.request_sync(abci.RequestCheckTx(tx=b"\x01")).code == 0
+
+    def test_multi_app_conn(self):
+        conn = MultiAppConn(LocalClientCreator(KVStoreApp()))
+        conn.start()
+        assert conn.query.echo_sync("z").message == "z"
+        assert conn.consensus is not None and conn.mempool is not None
+        conn.stop()
+
+    def test_json_wire_roundtrip(self):
+        req = abci.RequestBeginBlock(
+            hash=b"\x01\x02",
+            header=abci.ABCIHeader(chain_id="c", height=7),
+            last_commit_info=abci.LastCommitInfo(
+                round=1, votes=[abci.VoteInfo(address=b"\xaa" * 20, power=3)]
+            ),
+        )
+        rt = abci.msg_from_json(abci.msg_to_json(req))
+        assert rt == req
+
+
+class TestStateStore:
+    def test_state_roundtrip(self):
+        doc, _ = make_genesis(3)
+        st = state_from_genesis(doc)
+        db = MemDB()
+        store.save_state(db, st)
+        rt = store.load_state(db)
+        assert rt.chain_id == st.chain_id
+        assert rt.validators.hash() == st.validators.hash()
+        assert rt.last_block_height == 0
+
+    def test_validators_pointer_chasing(self):
+        doc, _ = make_genesis(2)
+        st = state_from_genesis(doc)
+        db = MemDB()
+        store.save_validators_info(db, 1, 1, st.validators)
+        store.save_validators_info(db, 2, 1, st.validators)  # pointer only
+        v2 = store.load_validators(db, 2)
+        assert v2.hash() == st.validators.hash()
+
+    def test_median_time_weighted(self):
+        doc, pvs = make_genesis(3)
+        st = state_from_genesis(doc)
+        bid = BlockID(hash=b"\x01" * 32)
+        votes = []
+        times = [100, 200, 300]
+        for i, val in enumerate(st.validators.validators):
+            pv = {p.get_pub_key().address(): p for p in pvs}[val.address]
+            v = Vote(
+                SignedMsgType.PRECOMMIT, 1, 0, times[i], bid, val.address, i
+            )
+            votes.append(pv.sign_vote(CHAIN_ID, v))
+        commit = Commit(block_id=bid, precommits=votes)
+        assert median_time(commit, st.validators) == 200
+
+
+class TestBlockExecutor:
+    def _setup(self, n_vals=1):
+        doc, pvs = make_genesis(n_vals)
+        st = state_from_genesis(doc)
+        state_db = MemDB()
+        store.save_state(state_db, st)
+        conn = MultiAppConn(LocalClientCreator(KVStoreApp()))
+        conn.start()
+        executor = BlockExecutor(state_db, conn.consensus)
+        return st, pvs, executor, state_db
+
+    def _apply_one(self, st, pvs, executor, height, txs, last_commit):
+        block = st.make_block(
+            height, txs, last_commit,
+            proposer_address=st.validators.get_proposer().address,
+        )
+        bid = BlockID(hash=block.hash(), parts_header=block.make_part_set().header())
+        new_state = executor.apply_block(st, bid, block)
+        # the commit for height H is signed by the validators active AT H
+        # (the pre-apply set) — it becomes block H+1's LastCommit
+        commit = commit_for(st, block, pvs, bid)
+        return new_state, block, bid, commit
+
+    def test_chain_of_blocks(self):
+        st, pvs, executor, _ = self._setup()
+        st1, b1, bid1, c1 = self._apply_one(st, pvs, executor, 1, [b"a=1"], Commit())
+        assert st1.last_block_height == 1
+        assert st1.app_hash != b""
+        st2, b2, bid2, c2 = self._apply_one(st1, pvs, executor, 2, [b"b=2", b"c=3"], c1)
+        assert st2.last_block_height == 2
+        assert st2.last_block_total_tx == 3
+        st3, *_ = self._apply_one(st2, pvs, executor, 3, [], c2)
+        assert st3.last_block_height == 3
+
+    def test_invalid_block_rejected(self):
+        from tendermint_tpu.state.execution import InvalidBlockError
+
+        st, pvs, executor, _ = self._setup()
+        block = st.make_block(
+            5, [], Commit(), proposer_address=st.validators.get_proposer().address
+        )
+        bid = BlockID(hash=block.hash(), parts_header=block.make_part_set().header())
+        with pytest.raises(InvalidBlockError):
+            executor.apply_block(st, bid, block)
+
+    def test_tampered_last_commit_rejected(self):
+        from tendermint_tpu.state.execution import InvalidBlockError
+
+        st, pvs, executor, _ = self._setup()
+        st1, b1, bid1, c1 = self._apply_one(st, pvs, executor, 1, [b"a=1"], Commit())
+        # corrupt the commit signature
+        bad = Commit(
+            block_id=c1.block_id,
+            precommits=[c1.precommits[0].with_signature(b"\x11" * 64)],
+        )
+        block2 = st1.make_block(
+            2, [], bad, proposer_address=st1.validators.get_proposer().address
+        )
+        bid2 = BlockID(hash=block2.hash(), parts_header=block2.make_part_set().header())
+        with pytest.raises(InvalidBlockError, match="signature"):
+            executor.apply_block(st1, bid2, block2)
+
+    def test_validator_set_change_via_endblock(self):
+        doc, pvs = make_genesis(1)
+        st = state_from_genesis(doc)
+        state_db = MemDB()
+        store.save_state(state_db, st)
+        app = PersistentKVStoreApp()
+        conn = MultiAppConn(LocalClientCreator(app))
+        conn.start()
+        executor = BlockExecutor(state_db, conn.consensus)
+
+        import base64
+
+        new_pv = MockPV(PrivKeyEd25519.generate(b"\x42" * 32))
+        pub_b64 = base64.b64encode(new_pv.get_pub_key().bytes())
+        tx = b"val:" + pub_b64 + b"!7"
+
+        st1, b1, bid1, c1 = TestBlockExecutor._apply_one(
+            self, st, pvs, executor, 1, [tx], Commit()
+        )
+        # change lands in NextValidators at H+1, active set at H+2
+        assert st1.next_validators.size == 2
+        assert st1.validators.size == 1
+        st2, *_ = TestBlockExecutor._apply_one(self, st1, pvs, executor, 2, [], c1)
+        assert st2.validators.size == 2
+        assert st2.last_height_validators_changed == 3
+
+    def test_abci_responses_persisted(self):
+        st, pvs, executor, state_db = self._setup()
+        st1, *_ = self._apply_one(st, pvs, executor, 1, [b"k=v"], Commit())
+        resp = store.load_abci_responses(state_db, 1)
+        assert len(resp.deliver_tx) == 1
+        assert resp.deliver_tx[0].code == abci.CODE_TYPE_OK
+        assert st1.last_results_hash == resp.results_hash()
+
+
+class TestBlockStore:
+    def test_save_load_roundtrip(self):
+        doc, pvs = make_genesis(1)
+        st = state_from_genesis(doc)
+        bs = BlockStore(MemDB())
+        block = st.make_block(
+            1, [b"t=1"], Commit(), proposer_address=st.validators.get_proposer().address
+        )
+        parts = block.make_part_set(256)
+        bid = BlockID(hash=block.hash(), parts_header=parts.header())
+        seen = commit_for(st, block, pvs, bid)
+        bs.save_block(block, parts, seen)
+        assert bs.height() == 1
+        loaded = bs.load_block(1)
+        assert loaded.hash() == block.hash()
+        meta = bs.load_block_meta(1)
+        assert meta.block_id == bid
+        sc = bs.load_seen_commit(1)
+        assert sc.block_id == bid
+        part = bs.load_block_part(1, 0)
+        assert part.bytes_ == parts.get_part(0).bytes_
+
+    def test_non_contiguous_rejected(self):
+        bs = BlockStore(MemDB())
+        doc, pvs = make_genesis(1)
+        st = state_from_genesis(doc)
+        block = st.make_block(
+            2, [], Commit(), proposer_address=st.validators.get_proposer().address
+        )
+        with pytest.raises(ValueError, match="contiguous"):
+            bs.save_block(block, block.make_part_set(256), Commit())
+
+
+class TestEventBus:
+    def test_tx_events_queryable(self):
+        bus = EventBus()
+        bus.start()
+        sub = bus.subscribe("test", "tm.event = 'Tx' AND tx.height = 5")
+        res = abci.ResponseDeliverTx(code=0, tags=[abci.KVPair(b"app.key", b"x")])
+        bus.publish_event_tx(5, 0, b"tx-bytes", res)
+        bus.publish_event_tx(6, 0, b"other", res)
+        msg = sub.get(timeout=1)
+        assert msg.data.height == 5
+        assert msg.tags["app.key"] == "x"
+        assert sub.queue.empty()
+        bus.stop()
