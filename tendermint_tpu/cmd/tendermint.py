@@ -1,0 +1,249 @@
+"""CLI (ref: cmd/tendermint — cobra commands at commands/).
+
+Commands: init, node, version, gen_validator, show_validator, gen_node_key,
+show_node_id, testnet, reset_all, reset_priv_validator.
+Run: python -m tendermint_tpu.cmd.tendermint <command> [--home DIR] ...
+"""
+
+from __future__ import annotations
+
+import argparse
+import base64
+import json
+import os
+import shutil
+import signal
+import sys
+import time
+
+VERSION = "tpu-0.1.0 (capabilities of reference v0.26.2)"
+
+
+def _home(args) -> str:
+    return os.path.abspath(args.home)
+
+
+def _config(args):
+    from tendermint_tpu.config.config import default_config
+
+    cfg = default_config()
+    cfg.set_root(_home(args))
+    if getattr(args, "proxy_app", None):
+        cfg.base.proxy_app = args.proxy_app
+    if getattr(args, "rpc_laddr", None):
+        cfg.rpc.laddr = args.rpc_laddr
+    if getattr(args, "p2p_laddr", None):
+        cfg.p2p.laddr = args.p2p_laddr
+    if getattr(args, "persistent_peers", None):
+        cfg.p2p.persistent_peers = args.persistent_peers
+    return cfg
+
+
+def cmd_init(args) -> int:
+    """Initialize home dir: priv validator, node key, genesis (commands/init.go)."""
+    from tendermint_tpu.privval.file_pv import FilePV
+    from tendermint_tpu.types import GenesisDoc, GenesisValidator
+
+    home = _home(args)
+    os.makedirs(os.path.join(home, "config"), exist_ok=True)
+    os.makedirs(os.path.join(home, "data"), exist_ok=True)
+    cfg = _config(args)
+
+    pv_path = cfg.base.priv_validator_path()
+    if os.path.exists(pv_path):
+        pv = FilePV.load(pv_path)
+        print(f"Found private validator: {pv_path}")
+    else:
+        pv = FilePV.generate(pv_path)
+        print(f"Generated private validator: {pv_path}")
+
+    genesis_path = cfg.base.genesis_path()
+    if os.path.exists(genesis_path):
+        print(f"Found genesis file: {genesis_path}")
+    else:
+        doc = GenesisDoc(
+            chain_id=args.chain_id or f"test-chain-{int(time.time())}",
+            genesis_time_ns=time.time_ns(),
+            validators=[GenesisValidator(pv.get_pub_key(), 10, "")],
+        )
+        doc.validate_and_complete()
+        doc.save_as(genesis_path)
+        print(f"Generated genesis file: {genesis_path}")
+    return 0
+
+
+def cmd_node(args) -> int:
+    """Run the node (commands/run_node.go)."""
+    from tendermint_tpu.libs.log import parse_log_level, setup
+    from tendermint_tpu.node.node import Node
+    from tendermint_tpu.privval.file_pv import FilePV
+
+    cfg = _config(args)
+    default, mods = parse_log_level(args.log_level)
+    setup(default, mods)
+    pv = FilePV.load_or_generate(cfg.base.priv_validator_path())
+    node = Node(cfg, priv_validator=pv)
+    node.start()
+    print(f"Node started. RPC: {cfg.rpc.laddr}", flush=True)
+
+    stop = []
+    signal.signal(signal.SIGINT, lambda *a: stop.append(1))
+    signal.signal(signal.SIGTERM, lambda *a: stop.append(1))
+    try:
+        while not stop:
+            time.sleep(0.2)
+    finally:
+        node.stop()
+    return 0
+
+
+def cmd_version(args) -> int:
+    print(VERSION)
+    return 0
+
+
+def cmd_gen_validator(args) -> int:
+    from tendermint_tpu.crypto.keys import PrivKeyEd25519
+
+    pk = PrivKeyEd25519.generate()
+    print(
+        json.dumps(
+            {
+                "address": pk.pub_key().address().hex().upper(),
+                "pub_key": pk.pub_key().to_json_obj(),
+                "priv_key": {
+                    "type": "ed25519",
+                    "value": base64.b64encode(pk.bytes()).decode(),
+                },
+            },
+            indent=2,
+        )
+    )
+    return 0
+
+
+def cmd_show_validator(args) -> int:
+    from tendermint_tpu.privval.file_pv import FilePV
+
+    cfg = _config(args)
+    pv = FilePV.load(cfg.base.priv_validator_path())
+    print(json.dumps(pv.get_pub_key().to_json_obj()))
+    return 0
+
+
+def cmd_gen_node_key(args) -> int:
+    from tendermint_tpu.p2p.key import NodeKey
+
+    cfg = _config(args)
+    os.makedirs(os.path.dirname(cfg.base.node_key_path()), exist_ok=True)
+    nk = NodeKey.load_or_generate(cfg.base.node_key_path())
+    print(nk.id())
+    return 0
+
+
+def cmd_show_node_id(args) -> int:
+    from tendermint_tpu.p2p.key import NodeKey
+
+    cfg = _config(args)
+    nk = NodeKey.load(cfg.base.node_key_path())
+    print(nk.id())
+    return 0
+
+
+def cmd_reset_all(args) -> int:
+    """Danger: wipe data + reset priv validator (commands/reset_priv_validator.go)."""
+    from tendermint_tpu.privval.file_pv import FilePV
+
+    cfg = _config(args)
+    data = cfg.base.db_path()
+    if os.path.isdir(data):
+        shutil.rmtree(data)
+        os.makedirs(data)
+        print(f"Removed all data in {data}")
+    pv_path = cfg.base.priv_validator_path()
+    if os.path.exists(pv_path):
+        FilePV.load(pv_path).reset()
+        print(f"Reset private validator to genesis state: {pv_path}")
+    return 0
+
+
+def cmd_reset_priv_validator(args) -> int:
+    from tendermint_tpu.privval.file_pv import FilePV
+
+    cfg = _config(args)
+    FilePV.load(cfg.base.priv_validator_path()).reset()
+    print(f"Reset private validator: {cfg.base.priv_validator_path()}")
+    return 0
+
+
+def cmd_testnet(args) -> int:
+    """Generate an N-validator testnet config tree (commands/testnet.go)."""
+    from tendermint_tpu.privval.file_pv import FilePV
+    from tendermint_tpu.types import GenesisDoc, GenesisValidator
+
+    out = os.path.abspath(args.output_dir)
+    n = args.v
+    pvs = []
+    for i in range(n):
+        node_dir = os.path.join(out, f"node{i}")
+        os.makedirs(os.path.join(node_dir, "config"), exist_ok=True)
+        os.makedirs(os.path.join(node_dir, "data"), exist_ok=True)
+        pvs.append(
+            FilePV.generate(os.path.join(node_dir, "config", "priv_validator.json"))
+        )
+    doc = GenesisDoc(
+        chain_id=args.chain_id or f"chain-{int(time.time())}",
+        genesis_time_ns=time.time_ns(),
+        validators=[
+            GenesisValidator(pv.get_pub_key(), 1, f"node{i}")
+            for i, pv in enumerate(pvs)
+        ],
+    )
+    doc.validate_and_complete()
+    for i in range(n):
+        doc.save_as(os.path.join(out, f"node{i}", "config", "genesis.json"))
+    print(f"Successfully initialized {n} node directories in {out}")
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="tendermint", description=__doc__)
+    p.add_argument("--home", default=os.path.expanduser("~/.tendermint_tpu"))
+    sub = p.add_subparsers(dest="command", required=True)
+
+    sp = sub.add_parser("init", help="initialize a home directory")
+    sp.add_argument("--chain-id", default="")
+    sp.set_defaults(fn=cmd_init)
+
+    sp = sub.add_parser("node", help="run the node")
+    sp.add_argument("--proxy_app", default="kvstore")
+    sp.add_argument("--rpc.laddr", dest="rpc_laddr", default="tcp://127.0.0.1:26657")
+    sp.add_argument("--p2p.laddr", dest="p2p_laddr", default="")
+    sp.add_argument("--p2p.persistent_peers", dest="persistent_peers", default="")
+    sp.add_argument("--log_level", default="info")
+    sp.set_defaults(fn=cmd_node)
+
+    for name, fn in [
+        ("version", cmd_version),
+        ("gen_validator", cmd_gen_validator),
+        ("show_validator", cmd_show_validator),
+        ("gen_node_key", cmd_gen_node_key),
+        ("show_node_id", cmd_show_node_id),
+        ("unsafe_reset_all", cmd_reset_all),
+        ("unsafe_reset_priv_validator", cmd_reset_priv_validator),
+    ]:
+        sp = sub.add_parser(name)
+        sp.set_defaults(fn=fn)
+
+    sp = sub.add_parser("testnet", help="generate a testnet config tree")
+    sp.add_argument("--v", type=int, default=4)
+    sp.add_argument("--output-dir", default="./mytestnet")
+    sp.add_argument("--chain-id", default="")
+    sp.set_defaults(fn=cmd_testnet)
+
+    args = p.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,179 @@
+"""Config — one struct with per-module sections (ref: config/config.go).
+
+Defaults mirror the reference (consensus timeouts config.go:573-580; test
+configs shrink to ~10-40ms, :592-594).  Durations are seconds (float).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+
+@dataclass
+class BaseConfig:
+    root_dir: str = ""
+    chain_id: str = ""
+    moniker: str = "anonymous"
+    fast_sync: bool = True
+    db_backend: str = "sqlite"  # role of goleveldb in the reference
+    db_dir: str = "data"
+    log_level: str = "info"
+    genesis_file: str = "config/genesis.json"
+    priv_validator_file: str = "config/priv_validator.json"
+    node_key_file: str = "config/node_key.json"
+    abci: str = "socket"
+    proxy_app: str = "tcp://127.0.0.1:26658"
+    prof_laddr: str = ""
+    filter_peers: bool = False
+
+    def genesis_path(self) -> str:
+        return os.path.join(self.root_dir, self.genesis_file)
+
+    def priv_validator_path(self) -> str:
+        return os.path.join(self.root_dir, self.priv_validator_file)
+
+    def node_key_path(self) -> str:
+        return os.path.join(self.root_dir, self.node_key_file)
+
+    def db_path(self) -> str:
+        return os.path.join(self.root_dir, self.db_dir)
+
+
+@dataclass
+class RPCConfig:
+    laddr: str = "tcp://0.0.0.0:26657"
+    grpc_laddr: str = ""
+    grpc_max_open_connections: int = 900
+    unsafe: bool = False
+    max_open_connections: int = 900
+
+
+@dataclass
+class P2PConfig:
+    laddr: str = "tcp://0.0.0.0:26656"
+    external_address: str = ""
+    seeds: str = ""
+    persistent_peers: str = ""
+    upnp: bool = False
+    addr_book_file: str = "config/addrbook.json"
+    addr_book_strict: bool = True
+    max_num_inbound_peers: int = 40
+    max_num_outbound_peers: int = 10
+    flush_throttle_timeout: float = 0.1  # 100ms (config.go:408)
+    max_packet_msg_payload_size: int = 1024
+    send_rate: int = 5120000
+    recv_rate: int = 5120000
+    pex: bool = True
+    seed_mode: bool = False
+    private_peer_ids: str = ""
+    allow_duplicate_ip: bool = False
+    handshake_timeout: float = 20.0
+    dial_timeout: float = 3.0
+    test_fuzz: bool = False
+
+    def addr_book_path(self, root: str) -> str:
+        return os.path.join(root, self.addr_book_file)
+
+
+@dataclass
+class MempoolConfig:
+    recheck: bool = True
+    broadcast: bool = True
+    wal_path: str = ""
+    size: int = 5000
+    cache_size: int = 10000
+
+
+@dataclass
+class ConsensusConfig:
+    wal_path: str = "data/cs.wal/wal"
+    # base timeouts (s) + per-round delta (config.go:573-580)
+    timeout_propose: float = 3.0
+    timeout_propose_delta: float = 0.5
+    timeout_prevote: float = 1.0
+    timeout_prevote_delta: float = 0.5
+    timeout_precommit: float = 1.0
+    timeout_precommit_delta: float = 0.5
+    timeout_commit: float = 1.0
+    skip_timeout_commit: bool = False
+    create_empty_blocks: bool = True
+    create_empty_blocks_interval: float = 0.0
+    peer_gossip_sleep_duration: float = 0.1
+    peer_query_maj23_sleep_duration: float = 2.0
+    blocktime_iota: float = 1.0  # min time between blocks (s)
+
+    def propose(self, round: int) -> float:
+        return self.timeout_propose + self.timeout_propose_delta * round
+
+    def prevote(self, round: int) -> float:
+        return self.timeout_prevote + self.timeout_prevote_delta * round
+
+    def precommit(self, round: int) -> float:
+        return self.timeout_precommit + self.timeout_precommit_delta * round
+
+    def commit(self, t: float) -> float:
+        """Deadline for starting the next height given commit time t."""
+        return t + self.timeout_commit
+
+    def wait_for_txs(self) -> bool:
+        return not self.create_empty_blocks or self.create_empty_blocks_interval > 0
+
+    def min_valid_vote_time_ns(self, block_time_ns: int) -> int:
+        return block_time_ns + int(self.blocktime_iota * 1e9)
+
+    def wal_file(self, root: str) -> str:
+        return os.path.join(root, self.wal_path)
+
+
+@dataclass
+class TxIndexConfig:
+    indexer: str = "kv"  # "kv" | "null"
+    index_tags: str = ""
+    index_all_tags: bool = False
+
+
+@dataclass
+class InstrumentationConfig:
+    prometheus: bool = False
+    prometheus_listen_addr: str = ":26660"
+    max_open_connections: int = 3
+    namespace: str = "tendermint"
+
+
+@dataclass
+class Config:
+    base: BaseConfig = field(default_factory=BaseConfig)
+    rpc: RPCConfig = field(default_factory=RPCConfig)
+    p2p: P2PConfig = field(default_factory=P2PConfig)
+    mempool: MempoolConfig = field(default_factory=MempoolConfig)
+    consensus: ConsensusConfig = field(default_factory=ConsensusConfig)
+    tx_index: TxIndexConfig = field(default_factory=TxIndexConfig)
+    instrumentation: InstrumentationConfig = field(default_factory=InstrumentationConfig)
+
+    def set_root(self, root: str) -> "Config":
+        self.base.root_dir = root
+        return self
+
+
+def default_config() -> Config:
+    return Config()
+
+
+def test_config() -> Config:
+    """Shrunken timeouts for tests (ref config.go:592-594 TestConsensusConfig)."""
+    c = Config()
+    c.base.fast_sync = False
+    c.consensus.timeout_propose = 0.5
+    c.consensus.timeout_propose_delta = 0.1
+    c.consensus.timeout_prevote = 0.1
+    c.consensus.timeout_prevote_delta = 0.05
+    c.consensus.timeout_precommit = 0.1
+    c.consensus.timeout_precommit_delta = 0.05
+    c.consensus.timeout_commit = 0.1
+    c.consensus.skip_timeout_commit = True
+    c.consensus.peer_gossip_sleep_duration = 0.005
+    c.consensus.peer_query_maj23_sleep_duration = 0.25
+    c.consensus.blocktime_iota = 0.0
+    return c

@@ -1,0 +1,195 @@
+"""Crash recovery: WAL message replay within a height + ABCI handshake block
+replay (ref: consensus/replay.go).
+
+Two tiers (SURVEY §3.5):
+  1. catchup_replay — re-feed WAL messages after #ENDHEIGHT(h-1) into the
+     state machine handlers so the round state catches up mid-height;
+  2. Handshaker — on startup, compare app height (ABCI Info) with store/state
+     heights and re-apply missing blocks so the app catches up to the store.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from tendermint_tpu.abci import types as abci
+from tendermint_tpu.consensus.messages import (
+    EndHeightMessage,
+    EventRoundStep,
+    MsgInfo,
+    TimeoutInfo,
+)
+from tendermint_tpu.consensus.wal import DataCorruptionError
+from tendermint_tpu.state import store as sm_store
+from tendermint_tpu.state.execution import (
+    BlockExecutor,
+    exec_block_on_proxy_app,
+    update_state,
+)
+from tendermint_tpu.state.state_types import State
+from tendermint_tpu.types import BlockID
+
+
+class ReplayError(Exception):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# Tier 1: WAL catchup within a height (replay.go:44-195)
+# ---------------------------------------------------------------------------
+
+
+def replay_one_message(cs, tm) -> None:
+    """Re-feed one timed WAL message into the handlers (replay.go:44)."""
+    msg = tm.msg
+    if isinstance(msg, EventRoundStep):
+        return  # informational
+    if isinstance(msg, TimeoutInfo):
+        cs._handle_timeout(msg, cs.rs)
+    elif isinstance(msg, MsgInfo):
+        cs._handle_msg(msg)
+    elif isinstance(msg, EndHeightMessage):
+        raise ReplayError(
+            f"unexpected EndHeight {msg.height} while replaying"
+        )
+
+
+def catchup_replay(cs, cs_height: int) -> None:
+    """Replay WAL messages since the last block (replay.go:97)."""
+    cs.replay_mode = True
+    try:
+        # sanity: nothing for this height should be fully written already
+        it = cs.wal.search_for_end_height(cs_height)
+        if it is not None:
+            raise ReplayError(
+                f"WAL should not contain #ENDHEIGHT {cs_height}"
+            )
+        it = cs.wal.search_for_end_height(cs_height - 1)
+        if it is None:
+            if cs_height > 1:
+                cs.logger.info(
+                    "WAL has no #ENDHEIGHT %d — starting fresh", cs_height - 1
+                )
+                return
+            # height 1: replay everything from the start
+            try:
+                it = cs.wal.iter_all()
+            except Exception:
+                return
+        count = 0
+        try:
+            for tm in it:
+                replay_one_message(cs, tm)
+                count += 1
+        except DataCorruptionError as e:
+            cs.logger.error("WAL corruption during replay: %s", e)
+        cs.logger.info("replayed %d WAL messages for height %d", count, cs_height)
+    finally:
+        cs.replay_mode = False
+
+
+# ---------------------------------------------------------------------------
+# Tier 2: ABCI handshake (replay.go:195-456)
+# ---------------------------------------------------------------------------
+
+
+class Handshaker:
+    def __init__(self, state_db, state: State, block_store, genesis_doc, logger=None):
+        self.state_db = state_db
+        self.initial_state = state
+        self.store = block_store
+        self.genesis = genesis_doc
+        self.n_blocks = 0
+        import logging
+
+        self.logger = logger or logging.getLogger("tm.handshaker")
+
+    def handshake(self, proxy_app) -> State:
+        """Sync the app with store/state; returns the possibly-updated state
+        (replay.go:227)."""
+        res = proxy_app.query.info_sync(abci.RequestInfo(version="tpu"))
+        app_height = max(0, res.last_block_height)
+        app_hash = res.last_block_app_hash
+        self.logger.info(
+            "ABCI handshake: app height=%d hash=%s", app_height, app_hash.hex()
+        )
+        state = self.replay_blocks(self.initial_state, app_hash, app_height, proxy_app)
+        return state
+
+    def replay_blocks(
+        self, state: State, app_hash: bytes, app_height: int, proxy_app
+    ) -> State:
+        store_height = self.store.height()
+        state_height = state.last_block_height
+
+        # genesis: app at 0 → InitChain
+        if app_height == 0:
+            validators = [
+                abci.ValidatorUpdate(
+                    pub_key_type="ed25519", pub_key=v.pub_key.bytes(), power=v.power
+                )
+                for v in self.genesis.validators
+            ]
+            req = abci.RequestInitChain(
+                time_ns=self.genesis.genesis_time_ns,
+                chain_id=self.genesis.chain_id,
+                validators=validators,
+            )
+            res = proxy_app.consensus.init_chain_sync(req)
+            if state.last_block_height == 0 and res.validators:
+                # the app overrode the genesis validator set (replay.go:301)
+                from tendermint_tpu.crypto.keys import PubKeyEd25519, PubKeySecp256k1
+                from tendermint_tpu.types import Validator, ValidatorSet
+
+                vals = []
+                for vu in res.validators:
+                    pk_cls = (
+                        PubKeyEd25519 if vu.pub_key_type == "ed25519" else PubKeySecp256k1
+                    )
+                    vals.append(Validator(pk_cls(vu.pub_key), vu.power))
+                vs = ValidatorSet(vals)
+                state.validators = vs
+                state.next_validators = vs.copy()
+                sm_store.save_state(self.state_db, state)
+
+        if store_height == 0:
+            return state
+
+        if store_height < app_height:
+            raise ReplayError(
+                f"app block height {app_height} ahead of store {store_height}"
+            )
+        if state_height > store_height:
+            raise ReplayError(
+                f"state height {state_height} ahead of store {store_height}"
+            )
+
+        # replay blocks the app is missing (and maybe the state too)
+        first = app_height + 1
+        for h in range(first, store_height + 1):
+            block = self.store.load_block(h)
+            if block is None:
+                raise ReplayError(f"missing block {h} in store")
+            if h <= state_height:
+                # app behind state: re-exec against the app only
+                self.logger.info("replaying block %d against app", h)
+                responses = exec_block_on_proxy_app(
+                    proxy_app.consensus, block, state.last_validators,
+                    self.state_db, self.logger,
+                )
+                res = proxy_app.consensus.commit_sync()
+                app_hash = res.data
+            else:
+                # both app and state need this block: full apply
+                self.logger.info("applying block %d (app + state)", h)
+                block_exec = BlockExecutor(self.state_db, proxy_app.consensus)
+                meta = self.store.load_block_meta(h)
+                state = block_exec.apply_block(state, meta.block_id, block)
+                app_hash = state.app_hash
+            self.n_blocks += 1
+
+        if state.last_block_height == store_height and state.app_hash != app_hash:
+            # state recorded a different app hash than the app reproduced
+            if app_hash:
+                state.app_hash = app_hash
+        return state

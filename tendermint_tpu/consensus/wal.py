@@ -1,0 +1,165 @@
+"""Consensus WAL — crash-durable log of every message before processing
+(ref: consensus/wal.go).
+
+Record framing: crc32(payload) fixed32 | uvarint(len) | payload, where payload
+is a timestamped consensus message (messages.py registry).  #ENDHEIGHT markers
+delimit heights; search_for_end_height scans chunks backwards like the
+reference (wal.go:159).
+"""
+
+from __future__ import annotations
+
+import io
+import struct
+import time
+import zlib
+from dataclasses import dataclass
+from typing import Iterator, Optional, Tuple
+
+from tendermint_tpu.consensus.messages import (
+    EndHeightMessage,
+    decode_msg,
+    encode_msg,
+)
+from tendermint_tpu.encoding.codec import Reader, Writer, encode_uvarint, read_uvarint
+from tendermint_tpu.libs.autofile import Group
+from tendermint_tpu.libs.service import BaseService
+
+MAX_MSG_SIZE_BYTES = 1024 * 1024  # 1MB (wal.go maxMsgSizeBytes)
+
+
+class DataCorruptionError(Exception):
+    """Recoverable WAL corruption point (wal.go IsDataCorruptionError)."""
+
+
+@dataclass
+class TimedWALMessage:
+    time_ns: int
+    msg: object
+
+    def marshal(self) -> bytes:
+        w = Writer()
+        w.fixed64(self.time_ns)
+        encode_msg(self.msg, w)
+        return w.build()
+
+    @classmethod
+    def unmarshal(cls, data: bytes) -> "TimedWALMessage":
+        r = Reader(data)
+        return cls(time_ns=r.fixed64(), msg=decode_msg(r))
+
+
+class WAL(BaseService):
+    def __init__(self, wal_file: str):
+        super().__init__("consensus.WAL")
+        self.group = Group(wal_file)
+
+    # writes ---------------------------------------------------------------
+    def write(self, msg: object) -> None:
+        """Buffered append (fsync'd lazily)."""
+        if not self.is_running:
+            return
+        payload = TimedWALMessage(time.time_ns(), msg).marshal()
+        if len(payload) > MAX_MSG_SIZE_BYTES:
+            raise ValueError(f"WAL msg too big: {len(payload)}")
+        rec = struct.pack("<I", zlib.crc32(payload)) + encode_uvarint(len(payload)) + payload
+        self.group.write(rec)
+        self.group.flush()
+
+    def write_sync(self, msg: object) -> None:
+        """Append + fsync (internal msgs and #ENDHEIGHT use this)."""
+        self.write(msg)
+        if self.is_running:
+            self.group.sync()
+
+    def on_start(self) -> None:
+        self.group.maybe_rotate()
+
+    def on_stop(self) -> None:
+        try:
+            self.group.sync()
+        except ValueError:
+            pass
+        self.group.close()
+
+    # reads ----------------------------------------------------------------
+    def _iter_records(self, start_index: int) -> Iterator[TimedWALMessage]:
+        reader = self.group.new_reader(start_index)
+        buf = reader.read()
+        reader.close()
+        pos = 0
+        n = len(buf)
+        while pos < n:
+            if n - pos < 4:
+                raise DataCorruptionError("truncated crc")
+            (crc,) = struct.unpack_from("<I", buf, pos)
+            pos += 4
+            r = io.BytesIO(buf[pos : pos + 10])
+            try:
+                length = read_uvarint(r)
+            except (EOFError, ValueError) as e:
+                raise DataCorruptionError(f"bad length varint: {e}") from e
+            pos += r.tell()
+            if length > MAX_MSG_SIZE_BYTES:
+                raise DataCorruptionError(f"length {length} too big")
+            if pos + length > n:
+                raise DataCorruptionError("truncated payload")
+            payload = buf[pos : pos + length]
+            pos += length
+            if zlib.crc32(payload) != crc:
+                raise DataCorruptionError("crc mismatch")
+            try:
+                yield TimedWALMessage.unmarshal(payload)
+            except (EOFError, ValueError) as e:
+                raise DataCorruptionError(f"undecodable payload: {e}") from e
+
+    def iter_all(self) -> Iterator[TimedWALMessage]:
+        return self._iter_records(self.group.min_index)
+
+    def search_for_end_height(
+        self, height: int
+    ) -> Optional[Iterator[TimedWALMessage]]:
+        """Iterator positioned right AFTER EndHeightMessage(height), or None
+        (wal.go:159 scans chunks backwards; we scan chunks newest-first and
+        replay forward within the chunk)."""
+        for idx in range(self.group.max_index, self.group.min_index - 1, -1):
+            found_at: Optional[int] = None
+            msgs = []
+            try:
+                for i, tm in enumerate(self._iter_records(idx)):
+                    msgs.append(tm)
+                    if isinstance(tm.msg, EndHeightMessage) and tm.msg.height == height:
+                        found_at = i
+            except DataCorruptionError:
+                if found_at is None:
+                    continue
+            if found_at is not None:
+                remaining = msgs[found_at + 1 :]
+
+                def _gen(start_chunk=idx, tail=remaining):
+                    for tm in tail:
+                        yield tm
+                    for later in range(start_chunk + 1, self.group.max_index + 1):
+                        yield from self._iter_records(later)
+
+                return _gen()
+        return None
+
+
+class NilWAL:
+    """No-op WAL (wal.go nilWAL) for tests/tools."""
+
+    def write(self, msg) -> None: ...
+
+    def write_sync(self, msg) -> None: ...
+
+    def start(self) -> None: ...
+
+    def stop(self) -> None: ...
+
+    def search_for_end_height(self, height: int):
+        return None
+
+    @property
+    def is_running(self) -> bool:
+        return True

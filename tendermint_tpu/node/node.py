@@ -1,0 +1,199 @@
+"""Node — composition root wiring every service (ref: node/node.go:152-567).
+
+NewNode order mirrored: stores → proxyApp (3 ABCI conns) → handshake/replay →
+mempool → evidence → BlockExecutor → consensus → eventBus → indexer → RPC.
+P2P attaches through the switch when networking is enabled; a single-validator
+node runs the full consensus loop without it (node.go:246-252 fastSync=false
+single-val path).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Optional
+
+from tendermint_tpu.blockchain.store import BlockStore
+from tendermint_tpu.config.config import Config
+from tendermint_tpu.consensus.replay import Handshaker
+from tendermint_tpu.consensus.state import ConsensusState
+from tendermint_tpu.consensus.wal import WAL
+from tendermint_tpu.evidence.pool import EvidencePool
+from tendermint_tpu.libs.db.kv import new_db
+from tendermint_tpu.libs.service import BaseService
+from tendermint_tpu.mempool.mempool import Mempool
+from tendermint_tpu.privval.file_pv import FilePV
+from tendermint_tpu.proxy.app_conn import (
+    ClientCreator,
+    MultiAppConn,
+    default_client_creator,
+)
+from tendermint_tpu.state import store as sm_store
+from tendermint_tpu.state.execution import BlockExecutor
+from tendermint_tpu.state.txindex.kv import KVTxIndexer, NullTxIndexer, TxIndexerService
+from tendermint_tpu.types import GenesisDoc
+from tendermint_tpu.types.events import EventBus
+
+
+class Node(BaseService):
+    def __init__(
+        self,
+        config: Config,
+        priv_validator: Optional[FilePV] = None,
+        client_creator: Optional[ClientCreator] = None,
+        genesis_doc: Optional[GenesisDoc] = None,
+        db_provider=None,
+        logger=None,
+    ):
+        super().__init__("Node", logger)
+        self.config = config
+        root = config.base.root_dir
+
+        def _db(name: str):
+            if db_provider is not None:
+                return db_provider(name)
+            return new_db(name, config.base.db_backend, config.base.db_path())
+
+        # stores
+        self.block_store_db = _db("blockstore")
+        self.block_store = BlockStore(self.block_store_db)
+        self.state_db = _db("state")
+
+        # genesis (cached in stateDB like node.go:831-856)
+        if genesis_doc is None:
+            raw = self.state_db.get(b"genesisDoc")
+            if raw is not None:
+                genesis_doc = GenesisDoc.from_json(raw.decode())
+            else:
+                genesis_doc = GenesisDoc.from_file(config.base.genesis_path())
+        self.state_db.set(b"genesisDoc", genesis_doc.to_json().encode())
+        self.genesis_doc = genesis_doc
+
+        state = sm_store.load_state_from_db_or_genesis(self.state_db, genesis_doc)
+
+        # app connections
+        creator = client_creator or default_client_creator(
+            config.base.proxy_app, config.base.proxy_app
+        )
+        self.proxy_app = MultiAppConn(creator)
+        self.proxy_app.start()
+
+        # handshake: sync app with store/state
+        handshaker = Handshaker(
+            self.state_db, state, self.block_store, genesis_doc
+        )
+        state = handshaker.handshake(self.proxy_app)
+        sm_store.save_state(self.state_db, state)
+
+        # priv validator
+        self.priv_validator = priv_validator
+
+        # event bus + indexer
+        self.event_bus = EventBus()
+        if config.tx_index.indexer == "kv":
+            self.tx_indexer = KVTxIndexer(_db("tx_index"))
+        else:
+            self.tx_indexer = NullTxIndexer()
+        self.indexer_service = TxIndexerService(self.tx_indexer, self.event_bus)
+
+        # mempool + evidence
+        self.mempool = Mempool(
+            self.proxy_app.mempool,
+            height=state.last_block_height,
+            size=config.mempool.size,
+            cache_size=config.mempool.cache_size,
+            recheck=config.mempool.recheck,
+        )
+        if config.consensus.wait_for_txs():
+            self.mempool.enable_txs_available()
+        self.evidence_pool = EvidencePool(self.state_db, _db("evidence"), state)
+
+        # block executor + consensus
+        self.block_exec = BlockExecutor(
+            self.state_db,
+            self.proxy_app.consensus,
+            self.mempool,
+            self.evidence_pool,
+            self.event_bus,
+        )
+        wal_file = config.consensus.wal_file(root) if root else None
+        wal = WAL(wal_file) if wal_file else None
+        self.consensus_state = ConsensusState(
+            config.consensus,
+            state.copy(),
+            self.block_exec,
+            self.block_store,
+            self.mempool,
+            self.evidence_pool,
+            wal=wal,
+        )
+        self.consensus_state.set_event_bus(self.event_bus)
+        if priv_validator is not None:
+            self.consensus_state.set_priv_validator(priv_validator)
+
+        self.rpc_server = None
+        self._rpc_env = None
+
+    # lifecycle -------------------------------------------------------------
+    def on_start(self) -> None:
+        self.event_bus.start()
+        self.indexer_service.start()
+        if self.config.rpc.laddr:
+            from tendermint_tpu.rpc.server import RPCServer
+            from tendermint_tpu.rpc.core.env import RPCEnv
+
+            self._rpc_env = RPCEnv(self)
+            self.rpc_server = RPCServer(self.config.rpc.laddr, self._rpc_env)
+            self.rpc_server.start()
+        self.consensus_state.start()
+        self.logger.info("node started chain_id=%s", self.genesis_doc.chain_id)
+
+    def on_stop(self) -> None:
+        for svc in (self.consensus_state, self.rpc_server, self.indexer_service,
+                    self.event_bus, self.proxy_app):
+            if svc is None:
+                continue
+            try:
+                svc.stop()
+            except Exception:
+                pass
+
+    # info -------------------------------------------------------------------
+    def status(self) -> dict:
+        rs = self.consensus_state.get_round_state()
+        latest_height = self.block_store.height()
+        meta = self.block_store.load_block_meta(latest_height) if latest_height else None
+        pub = (
+            self.priv_validator.get_pub_key() if self.priv_validator else None
+        )
+        return {
+            "node_info": {
+                "network": self.genesis_doc.chain_id,
+                "moniker": self.config.base.moniker,
+                "version": "tpu-0.1.0",
+            },
+            "sync_info": {
+                "latest_block_height": latest_height,
+                "latest_block_hash": (
+                    meta.block_id.hash.hex().upper() if meta else ""
+                ),
+                "latest_app_hash": (
+                    meta.header.app_hash.hex().upper() if meta else ""
+                ),
+                "latest_block_time_ns": meta.header.time_ns if meta else 0,
+                "catching_up": False,
+            },
+            "validator_info": {
+                "address": pub.address().hex().upper() if pub else "",
+                "voting_power": (
+                    self.consensus_state.rs.validators.get_by_address(pub.address())[1].voting_power
+                    if pub and self.consensus_state.rs.validators.has_address(pub.address())
+                    else 0
+                ),
+            },
+            "consensus_state": {
+                "height": rs.height,
+                "round": rs.round,
+                "step": rs.step.name,
+            },
+        }

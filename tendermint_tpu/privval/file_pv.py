@@ -24,9 +24,9 @@ from tendermint_tpu.types.proposal import Heartbeat, Proposal
 from tendermint_tpu.types.vote import Vote
 
 STEP_NONE = 0
-STEP_PREVOTE = 1
-STEP_PRECOMMIT = 2
-STEP_PROPOSE = 3
+STEP_PROPOSE = 1  # the proposal precedes votes within a round
+STEP_PREVOTE = 2
+STEP_PRECOMMIT = 3
 
 _VOTE_TO_STEP = {0x01: STEP_PREVOTE, 0x02: STEP_PRECOMMIT}
 

@@ -1,0 +1,306 @@
+"""RPC core handlers — read node state, broadcast txs
+(ref: rpc/core/ routes at rpc/core/routes.go:9-41; wiring node/node.go:618).
+
+Every handler returns JSON-able dicts.  Errors raise RPCError(code, message).
+"""
+
+from __future__ import annotations
+
+import base64
+import queue
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from tendermint_tpu.abci import types as abci
+from tendermint_tpu.types.events import EVENT_TX, TX_HASH_KEY, query_for_event
+
+
+class RPCError(Exception):
+    def __init__(self, code: int, message: str):
+        super().__init__(message)
+        self.code = code
+        self.message = message
+
+
+def _b64(b: bytes) -> str:
+    return base64.b64encode(b).decode()
+
+
+class RPCEnv:
+    """The handler table; method names match the reference routes."""
+
+    def __init__(self, node):
+        self.node = node
+
+    # info ------------------------------------------------------------------
+    def health(self) -> dict:
+        return {}
+
+    def status(self) -> dict:
+        return self.node.status()
+
+    def genesis(self) -> dict:
+        import json
+
+        return {"genesis": json.loads(self.node.genesis_doc.to_json())}
+
+    def block(self, height: Optional[int] = None) -> dict:
+        bs = self.node.block_store
+        h = int(height) if height else bs.height()
+        meta = bs.load_block_meta(h)
+        if meta is None:
+            raise RPCError(-32603, f"no block for height {h}")
+        block = bs.load_block(h)
+        return {
+            "block_meta": {
+                "block_id": {
+                    "hash": meta.block_id.hash.hex().upper(),
+                    "parts": {
+                        "total": meta.block_id.parts_header.total,
+                        "hash": meta.block_id.parts_header.hash.hex().upper(),
+                    },
+                },
+                "header": _header_json(meta.header),
+            },
+            "block": {
+                "header": _header_json(block.header),
+                "data": {"txs": [_b64(bytes(t)) for t in block.data.txs]},
+                "last_commit": {
+                    "block_id": {"hash": block.last_commit.block_id.hash.hex().upper()},
+                    "precommits_count": sum(
+                        1 for pc in block.last_commit.precommits if pc
+                    ),
+                },
+            },
+        }
+
+    def commit(self, height: Optional[int] = None) -> dict:
+        bs = self.node.block_store
+        h = int(height) if height else bs.height()
+        meta = bs.load_block_meta(h)
+        if meta is None:
+            raise RPCError(-32603, f"no commit for height {h}")
+        commit = bs.load_block_commit(h) or bs.load_seen_commit(h)
+        return {
+            "signed_header": {
+                "header": _header_json(meta.header),
+                "commit": {
+                    "block_id": {"hash": commit.block_id.hash.hex().upper()},
+                    "precommits_count": sum(1 for pc in commit.precommits if pc),
+                },
+            },
+            "canonical": bs.load_block_commit(h) is not None,
+        }
+
+    def validators(self, height: Optional[int] = None) -> dict:
+        from tendermint_tpu.state import store as sm_store
+
+        h = int(height) if height else self.node.block_store.height() + 1
+        vals = sm_store.load_validators(self.node.state_db, h)
+        return {
+            "block_height": h,
+            "validators": [
+                {
+                    "address": v.address.hex().upper(),
+                    "pub_key": v.pub_key.to_json_obj(),
+                    "voting_power": v.voting_power,
+                    "accum": v.accum,
+                }
+                for v in vals.validators
+            ],
+        }
+
+    def dump_consensus_state(self) -> dict:
+        rs = self.node.consensus_state.get_round_state()
+        return {
+            "round_state": {
+                "height": rs.height,
+                "round": rs.round,
+                "step": rs.step.name,
+                "locked_round": rs.locked_round,
+                "valid_round": rs.valid_round,
+                "proposal": str(rs.proposal) if rs.proposal else None,
+            }
+        }
+
+    def net_info(self) -> dict:
+        sw = getattr(self.node, "switch", None)
+        peers = []
+        if sw is not None:
+            for p in sw.peers_list():
+                peers.append({"node_info": p.node_info_dict(), "is_outbound": p.outbound})
+        return {"listening": sw is not None, "peers": peers, "n_peers": len(peers)}
+
+    def unconfirmed_txs(self, limit: int = 30) -> dict:
+        txs = self.node.mempool.reap_max_txs(int(limit))
+        return {
+            "n_txs": self.node.mempool.size(),
+            "txs": [_b64(t) for t in txs],
+        }
+
+    def num_unconfirmed_txs(self) -> dict:
+        return {"n_txs": self.node.mempool.size()}
+
+    # tx --------------------------------------------------------------------
+    def broadcast_tx_async(self, tx: str) -> dict:
+        raw = base64.b64decode(tx)
+        self.node.mempool.check_tx(raw)
+        import hashlib
+
+        return {"code": 0, "data": "", "log": "", "hash": hashlib.sha256(raw).hexdigest().upper()}
+
+    def broadcast_tx_sync(self, tx: str) -> dict:
+        raw = base64.b64decode(tx)
+        done: "queue.Queue" = queue.Queue()
+        self.node.mempool.check_tx(raw, callback=done.put)
+        try:
+            res = done.get(timeout=10)
+        except queue.Empty:
+            raise RPCError(-32603, "CheckTx timed out")
+        import hashlib
+
+        return {
+            "code": res.code,
+            "data": _b64(res.data),
+            "log": res.log,
+            "hash": hashlib.sha256(raw).hexdigest().upper(),
+        }
+
+    def broadcast_tx_commit(self, tx: str) -> dict:
+        """Subscribe to the tx event, CheckTx, wait for commit
+        (rpc/core/mempool.go:152)."""
+        raw = base64.b64decode(tx)
+        import hashlib
+
+        tx_hash = hashlib.sha256(raw).hexdigest().upper()
+        bus = self.node.event_bus
+        sub_id = f"broadcast-{tx_hash}-{time.monotonic_ns()}"
+        sub = bus.subscribe(
+            sub_id, f"{query_for_event(EVENT_TX)} AND {TX_HASH_KEY} = '{tx_hash}'"
+        )
+        try:
+            done: "queue.Queue" = queue.Queue()
+            self.node.mempool.check_tx(raw, callback=done.put)
+            try:
+                check_res = done.get(timeout=10)
+            except queue.Empty:
+                raise RPCError(-32603, "CheckTx timed out")
+            if check_res.code != abci.CODE_TYPE_OK:
+                return {
+                    "check_tx": _tx_res_json(check_res),
+                    "deliver_tx": {},
+                    "hash": tx_hash,
+                    "height": 0,
+                }
+            try:
+                msg = sub.get(timeout=30)
+            except queue.Empty:
+                raise RPCError(-32603, "timed out waiting for tx to be committed")
+            ev = msg.data
+            return {
+                "check_tx": _tx_res_json(check_res),
+                "deliver_tx": _tx_res_json(ev.result),
+                "hash": tx_hash,
+                "height": ev.height,
+            }
+        finally:
+            try:
+                bus.unsubscribe_all(sub_id)
+            except Exception:
+                pass
+
+    def tx(self, hash: str, prove: bool = False) -> dict:
+        raw_hash = bytes.fromhex(hash)
+        r = self.node.tx_indexer.get(raw_hash)
+        if r is None:
+            raise RPCError(-32603, f"tx ({hash}) not found")
+        return {
+            "hash": hash.upper(),
+            "height": r.height,
+            "index": r.index,
+            "tx_result": _tx_res_json(r.result),
+            "tx": _b64(r.tx),
+        }
+
+    def tx_search(self, query: str, prove: bool = False, page: int = 1,
+                  per_page: int = 30) -> dict:
+        results = self.node.tx_indexer.search(query)
+        page, per_page = int(page), int(per_page)
+        start = (page - 1) * per_page
+        sel = results[start : start + per_page]
+        return {
+            "txs": [
+                {
+                    "hash": r.hash().hex().upper(),
+                    "height": r.height,
+                    "index": r.index,
+                    "tx_result": _tx_res_json(r.result),
+                    "tx": _b64(r.tx),
+                }
+                for r in sel
+            ],
+            "total_count": len(results),
+        }
+
+    # abci ------------------------------------------------------------------
+    def abci_query(self, path: str = "", data: str = "", height: int = 0,
+                   prove: bool = False) -> dict:
+        res = self.node.proxy_app.query.query_sync(
+            abci.RequestQuery(
+                data=bytes.fromhex(data) if data else b"",
+                path=path,
+                height=int(height),
+                prove=bool(prove),
+            )
+        )
+        return {
+            "response": {
+                "code": res.code,
+                "log": res.log,
+                "key": _b64(res.key),
+                "value": _b64(res.value),
+                "height": res.height,
+            }
+        }
+
+    def abci_info(self) -> dict:
+        res = self.node.proxy_app.query.info_sync(abci.RequestInfo())
+        return {
+            "response": {
+                "data": res.data,
+                "version": res.version,
+                "last_block_height": res.last_block_height,
+                "last_block_app_hash": _b64(res.last_block_app_hash),
+            }
+        }
+
+
+def _header_json(h) -> dict:
+    return {
+        "chain_id": h.chain_id,
+        "height": h.height,
+        "time_ns": h.time_ns,
+        "num_txs": h.num_txs,
+        "total_txs": h.total_txs,
+        "last_block_id": {"hash": h.last_block_id.hash.hex().upper()},
+        "app_hash": h.app_hash.hex().upper(),
+        "data_hash": h.data_hash.hex().upper(),
+        "validators_hash": h.validators_hash.hex().upper(),
+        "proposer_address": h.proposer_address.hex().upper(),
+    }
+
+
+def _tx_res_json(res) -> dict:
+    if res is None:
+        return {}
+    return {
+        "code": res.code,
+        "data": _b64(res.data),
+        "log": res.log,
+        "gas_wanted": res.gas_wanted,
+        "gas_used": res.gas_used,
+        "tags": [
+            {"key": _b64(kv.key), "value": _b64(kv.value)} for kv in res.tags
+        ],
+    }

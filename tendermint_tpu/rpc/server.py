@@ -1,0 +1,133 @@
+"""JSON-RPC 2.0 over HTTP (+ GET URI routes) (ref: rpc/lib/server/).
+
+POST / with {"jsonrpc":"2.0","method":...,"params":...} or GET /<method>?arg=v
+— the same dual surface the reference exposes.  Handlers come from
+rpc.core.env.RPCEnv; public callables become routes (reflection dispatch like
+rpc/lib/server's func-signature routing).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from tendermint_tpu.libs.service import BaseService
+from tendermint_tpu.rpc.core.env import RPCEnv, RPCError
+
+
+def _parse_laddr(laddr: str):
+    if laddr.startswith("tcp://"):
+        laddr = laddr[len("tcp://"):]
+    host, port = laddr.rsplit(":", 1)
+    return host or "0.0.0.0", int(port)
+
+
+class RPCServer(BaseService):
+    def __init__(self, laddr: str, env: RPCEnv):
+        super().__init__("rpc.Server")
+        self.laddr = laddr
+        self.env = env
+        self._httpd: Optional[ThreadingHTTPServer] = None
+
+    @property
+    def bound_port(self) -> Optional[int]:
+        return self._httpd.server_address[1] if self._httpd else None
+
+    def on_start(self) -> None:
+        env = self.env
+        logger = self.logger
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):
+                logger.debug("rpc: " + fmt, *args)
+
+            def _send(self, obj, status=200):
+                body = json.dumps(obj).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _call(self, method: str, params: dict, req_id):
+                fn = getattr(env, method, None)
+                if fn is None or method.startswith("_") or not callable(fn):
+                    return self._send(
+                        _err(req_id, -32601, f"method {method!r} not found")
+                    )
+                try:
+                    result = fn(**params)
+                    self._send({"jsonrpc": "2.0", "id": req_id, "result": result})
+                except RPCError as e:
+                    self._send(_err(req_id, e.code, e.message))
+                except TypeError as e:
+                    self._send(_err(req_id, -32602, f"invalid params: {e}"))
+                except Exception as e:
+                    logger.error("rpc %s failed: %s", method, e)
+                    self._send(_err(req_id, -32603, str(e)))
+
+            def do_POST(self):
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                    req = json.loads(self.rfile.read(length) or b"{}")
+                except (ValueError, json.JSONDecodeError):
+                    return self._send(_err(None, -32700, "parse error"), 400)
+                method = req.get("method", "")
+                params = req.get("params") or {}
+                if isinstance(params, list):
+                    return self._send(
+                        _err(req.get("id"), -32602, "positional params unsupported")
+                    )
+                self._call(method, params, req.get("id"))
+
+            def do_GET(self):
+                parsed = urllib.parse.urlparse(self.path)
+                method = parsed.path.strip("/")
+                if method == "":
+                    # route listing, like the reference's index page
+                    routes = sorted(
+                        m for m in dir(env)
+                        if not m.startswith("_") and callable(getattr(env, m))
+                    )
+                    return self._send({"jsonrpc": "2.0", "result": {"routes": routes}})
+                params = {
+                    k: _coerce(v[0])
+                    for k, v in urllib.parse.parse_qs(parsed.query).items()
+                }
+                self._call(method, params, -1)
+
+        host, port = _parse_laddr(self.laddr)
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        threading.Thread(target=self._httpd.serve_forever, daemon=True).start()
+        self.logger.info("RPC listening on %s", self.laddr)
+
+    def on_stop(self) -> None:
+        if self._httpd:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+
+
+def _coerce(v: str):
+    """GET query params arrive as strings; unquote the reference's conventions:
+    0x-prefixed hex stays string, quoted strings unquote, ints parse."""
+    if v.startswith('"') and v.endswith('"'):
+        return v[1:-1]
+    if v in ("true", "false"):
+        return v == "true"
+    try:
+        return int(v)
+    except ValueError:
+        return v
+
+
+def _err(req_id, code, message):
+    return {
+        "jsonrpc": "2.0",
+        "id": req_id,
+        "error": {"code": code, "message": message},
+    }

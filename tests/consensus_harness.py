@@ -1,0 +1,133 @@
+"""In-proc consensus test fixtures (ref: consensus/common_test.go).
+
+validatorStub — scripted peer signing real votes with MockPV;
+make_consensus_state — full ConsensusState over in-memory stores + kvstore app.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import List, Optional, Tuple
+
+from tendermint_tpu.abci.examples.kvstore import KVStoreApp
+from tendermint_tpu.blockchain.store import BlockStore
+from tendermint_tpu.config.config import test_config
+from tendermint_tpu.consensus.state import ConsensusState
+from tendermint_tpu.crypto.keys import PrivKeyEd25519
+from tendermint_tpu.libs.db.kv import MemDB
+from tendermint_tpu.mempool.mempool import Mempool
+from tendermint_tpu.proxy.app_conn import LocalClientCreator, MultiAppConn
+from tendermint_tpu.state import store as sm_store
+from tendermint_tpu.state.execution import BlockExecutor
+from tendermint_tpu.state.services import MockEvidencePool
+from tendermint_tpu.state.state_types import state_from_genesis
+from tendermint_tpu.types import (
+    BlockID,
+    GenesisDoc,
+    GenesisValidator,
+    MockPV,
+    SignedMsgType,
+    Vote,
+)
+from tendermint_tpu.types.events import EventBus
+
+CHAIN_ID = "cs-test-chain"
+
+
+class ValidatorStub:
+    """Scripted co-validator (common_test.go:58)."""
+
+    def __init__(self, pv: MockPV, index: int):
+        self.pv = pv
+        self.index = index
+        self.height = 1
+        self.round = 0
+
+    @property
+    def address(self) -> bytes:
+        return self.pv.get_pub_key().address()
+
+    def sign_vote(
+        self, vtype: SignedMsgType, block_id: BlockID,
+        height: Optional[int] = None, round: Optional[int] = None,
+    ) -> Vote:
+        vote = Vote(
+            vote_type=vtype,
+            height=height if height is not None else self.height,
+            round=round if round is not None else self.round,
+            timestamp_ns=time.time_ns(),
+            block_id=block_id,
+            validator_address=self.address,
+            validator_index=self.index,
+        )
+        return self.pv.sign_vote(CHAIN_ID, vote)
+
+
+def make_genesis(n_vals: int, power: int = 10):
+    pvs = [MockPV(PrivKeyEd25519.generate(bytes([i + 1]) * 32)) for i in range(n_vals)]
+    doc = GenesisDoc(
+        chain_id=CHAIN_ID,
+        genesis_time_ns=1_700_000_000_000_000_000,
+        validators=[GenesisValidator(pv.get_pub_key(), power) for pv in pvs],
+    )
+    doc.validate_and_complete()
+    return doc, pvs
+
+
+def make_consensus_state(
+    n_vals: int,
+    our_index: int = 0,
+    config=None,
+    wal=None,
+    state_db=None,
+    block_store_db=None,
+    app=None,
+) -> Tuple[ConsensusState, List[ValidatorStub], EventBus]:
+    """Our ConsensusState at validator `our_index` + stubs for the rest,
+    indexed by position in the sorted validator set."""
+    cfg = config or test_config()
+    doc, pvs = make_genesis(n_vals)
+    st = state_from_genesis(doc)
+    state_db = state_db if state_db is not None else MemDB()
+    sm_store.save_state(state_db, st)
+
+    conn = MultiAppConn(LocalClientCreator(app or KVStoreApp()))
+    conn.start()
+    mempool = Mempool(conn.mempool)
+    evpool = MockEvidencePool()
+    block_store = BlockStore(block_store_db if block_store_db is not None else MemDB())
+
+    bus = EventBus()
+    bus.start()
+    block_exec = BlockExecutor(state_db, conn.consensus, mempool, evpool, bus)
+
+    cs = ConsensusState(
+        cfg.consensus, st.copy(), block_exec, block_store, mempool, evpool, wal=wal
+    )
+    cs.set_event_bus(bus)
+
+    # order stubs by sorted-set index
+    by_addr = {pv.get_pub_key().address(): pv for pv in pvs}
+    sorted_pvs = [by_addr[v.address] for v in st.validators.validators]
+    cs.set_priv_validator(sorted_pvs[our_index])
+    stubs = [
+        ValidatorStub(pv, i)
+        for i, pv in enumerate(sorted_pvs)
+        if i != our_index
+    ]
+    return cs, stubs, bus
+
+
+def wait_for(predicate, timeout: float = 10.0, interval: float = 0.01) -> bool:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def wait_for_event(sub, timeout: float = 10.0):
+    return sub.get(timeout=timeout)
