@@ -34,6 +34,61 @@ class ReplayError(Exception):
     pass
 
 
+class _MockAppConnConsensus:
+    """Consensus app-conn that replays recorded ABCIResponses instead of
+    re-executing txs (ref: replay.go:457 mockProxyApp).
+
+    Used when the app already ran Commit for a block but the node crashed
+    before save_state: re-running the real app would double-apply the txs.
+    """
+
+    def __init__(self, app_hash: bytes, abci_responses: "sm_store.ABCIResponses"):
+        self._app_hash = app_hash
+        self._responses = abci_responses
+        self._tx_count = 0
+        self._cb = None
+
+    def set_response_callback(self, cb):
+        self._cb = cb
+
+    def error(self):
+        return None
+
+    def begin_block_sync(self, req):
+        return self._responses.begin_block or abci.ResponseBeginBlock()
+
+    def deliver_tx_async(self, tx: bytes):
+        if self._tx_count >= len(self._responses.deliver_tx):
+            raise ReplayError(
+                f"recorded ABCIResponses truncated: only "
+                f"{len(self._responses.deliver_tx)} DeliverTx responses"
+            )
+        res = self._responses.deliver_tx[self._tx_count]
+        self._tx_count += 1
+        if self._cb is not None:
+            self._cb(abci.RequestDeliverTx(tx=tx), res)
+        return res
+
+    def end_block_sync(self, req):
+        return self._responses.end_block or abci.ResponseEndBlock()
+
+    def commit_sync(self):
+        return abci.ResponseCommit(data=self._app_hash)
+
+
+def _abci_consensus_params(params) -> abci.ConsensusParams:
+    """types.ConsensusParams → abci.ConsensusParams (for RequestInitChain)."""
+    return abci.ConsensusParams(
+        block_size=abci.BlockSizeParams(
+            max_bytes=params.block_size.max_bytes, max_gas=params.block_size.max_gas
+        ),
+        evidence=abci.EvidenceParams(max_age=params.evidence.max_age),
+        validator=abci.ValidatorParams(
+            pub_key_types=list(params.validator.pub_key_types)
+        ),
+    )
+
+
 # ---------------------------------------------------------------------------
 # Tier 1: WAL catchup within a height (replay.go:44-195)
 # ---------------------------------------------------------------------------
@@ -122,34 +177,47 @@ class Handshaker:
         store_height = self.store.height()
         state_height = state.last_block_height
 
-        # genesis: app at 0 → InitChain
+        # genesis: app at 0 → InitChain (replay.go:280-313)
         if app_height == 0:
             validators = [
                 abci.ValidatorUpdate(
-                    pub_key_type="ed25519", pub_key=v.pub_key.bytes(), power=v.power
+                    pub_key_type=(
+                        "secp256k1" if "Secp256k1" in v.pub_key.type_name else "ed25519"
+                    ),
+                    pub_key=v.pub_key.bytes(),
+                    power=v.power,
                 )
                 for v in self.genesis.validators
             ]
             req = abci.RequestInitChain(
                 time_ns=self.genesis.genesis_time_ns,
                 chain_id=self.genesis.chain_id,
+                consensus_params=_abci_consensus_params(self.genesis.consensus_params),
                 validators=validators,
             )
             res = proxy_app.consensus.init_chain_sync(req)
-            if state.last_block_height == 0 and res.validators:
-                # the app overrode the genesis validator set (replay.go:301)
-                from tendermint_tpu.crypto.keys import PubKeyEd25519, PubKeySecp256k1
-                from tendermint_tpu.types import Validator, ValidatorSet
-
-                vals = []
-                for vu in res.validators:
-                    pk_cls = (
-                        PubKeyEd25519 if vu.pub_key_type == "ed25519" else PubKeySecp256k1
+            if state.last_block_height == 0:
+                # only apply the app's genesis overrides if we're starting
+                # from genesis ourselves (replay.go:294-303)
+                if res.consensus_params is not None:
+                    state.consensus_params = state.consensus_params.update(
+                        res.consensus_params
                     )
-                    vals.append(Validator(pk_cls(vu.pub_key), vu.power))
-                vs = ValidatorSet(vals)
-                state.validators = vs
-                state.next_validators = vs.copy()
+                    state.consensus_params.validate()
+                if res.validators:
+                    # the app overrode the genesis validator set (replay.go:301)
+                    from tendermint_tpu.crypto.keys import PubKeyEd25519, PubKeySecp256k1
+                    from tendermint_tpu.types import Validator, ValidatorSet
+
+                    vals = []
+                    for vu in res.validators:
+                        pk_cls = (
+                            PubKeyEd25519 if vu.pub_key_type == "ed25519" else PubKeySecp256k1
+                        )
+                        vals.append(Validator(pk_cls(vu.pub_key), vu.power))
+                    vs = ValidatorSet(vals)
+                    state.validators = vs
+                    state.next_validators = vs.copy()
                 sm_store.save_state(self.state_db, state)
 
         if store_height == 0:
@@ -163,6 +231,14 @@ class Handshaker:
             raise ReplayError(
                 f"state height {state_height} ahead of store {store_height}"
             )
+        if store_height > state_height + 1:
+            # the store can lead the state by at most one block (the crash
+            # window between SaveBlock and save_state) — anything more means
+            # a corrupted DB (replay.go:320-322)
+            raise ReplayError(
+                f"store height {store_height} more than one ahead of "
+                f"state height {state_height}"
+            )
 
         # replay blocks the app is missing (and maybe the state too)
         first = app_height + 1
@@ -171,10 +247,18 @@ class Handshaker:
             if block is None:
                 raise ReplayError(f"missing block {h} in store")
             if h <= state_height:
-                # app behind state: re-exec against the app only
+                # app behind state: re-exec against the app only, with the
+                # validator set that actually signed block h's LastCommit
                 self.logger.info("replaying block %d against app", h)
-                responses = exec_block_on_proxy_app(
-                    proxy_app.consensus, block, state.last_validators,
+                if h > 1:
+                    try:
+                        hist_vals = sm_store.load_validators(self.state_db, h - 1)
+                    except Exception:
+                        hist_vals = state.last_validators
+                else:
+                    hist_vals = state.last_validators  # empty LastCommit at h=1
+                exec_block_on_proxy_app(
+                    proxy_app.consensus, block, hist_vals,
                     self.state_db, self.logger,
                 )
                 res = proxy_app.consensus.commit_sync()
@@ -188,8 +272,32 @@ class Handshaker:
                 app_hash = state.app_hash
             self.n_blocks += 1
 
+        if app_height == store_height == state_height + 1:
+            # the app ran Commit for the last stored block but we crashed
+            # before save_state: re-running the real app would double-apply
+            # its txs. Replay the block against a mock conn that returns the
+            # recorded ABCIResponses + app hash (replay.go:357-365, :457).
+            self.logger.info(
+                "replaying block %d with recorded responses (app ahead of state)",
+                store_height,
+            )
+            abci_responses = sm_store.load_abci_responses(self.state_db, store_height)
+            mock_conn = _MockAppConnConsensus(app_hash, abci_responses)
+            block = self.store.load_block(store_height)
+            if block is None:
+                raise ReplayError(f"missing block {store_height} in store")
+            meta = self.store.load_block_meta(store_height)
+            if meta is None:
+                raise ReplayError(f"missing block meta {store_height} in store")
+            block_exec = BlockExecutor(self.state_db, mock_conn)
+            state = block_exec.apply_block(state, meta.block_id, block)
+            self.n_blocks += 1
+
         if state.last_block_height == store_height and state.app_hash != app_hash:
-            # state recorded a different app hash than the app reproduced
-            if app_hash:
-                state.app_hash = app_hash
+            # app nondeterminism or data corruption — halt, don't mask it
+            # (replay.go checkAppHash panics here)
+            raise ReplayError(
+                f"app hash mismatch at height {store_height}: state has "
+                f"{state.app_hash.hex()}, app reproduced {app_hash.hex()}"
+            )
         return state

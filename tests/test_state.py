@@ -311,3 +311,146 @@ class TestEventBus:
         assert msg.tags["app.key"] == "x"
         assert sub.queue.empty()
         bus.stop()
+
+
+class TestHandshaker:
+    """Handshaker matrix: app behind store by 0..N blocks × state behind store
+    by 0/1 (the crash window), mirroring replay_test.go:271-292."""
+
+    N = 3
+
+    def _build_chain(self):
+        from tendermint_tpu.consensus.replay import Handshaker  # noqa: F401
+
+        doc, pvs = make_genesis(1)
+        st = state_from_genesis(doc)
+        state_db = MemDB()
+        store.save_state(state_db, st)
+        block_store = BlockStore(MemDB())
+        conn = MultiAppConn(LocalClientCreator(KVStoreApp()))
+        conn.start()
+        executor = BlockExecutor(state_db, conn.consensus)
+        states = {0: st.marshal()}
+        last_commit = Commit()
+        cur = st
+        for h in range(1, self.N + 1):
+            block = cur.make_block(
+                h,
+                [b"k%d=v%d" % (h, h)],
+                last_commit,
+                proposer_address=cur.validators.get_proposer().address,
+            )
+            parts = block.make_part_set()
+            bid = BlockID(hash=block.hash(), parts_header=parts.header())
+            new_state = executor.apply_block(cur, bid, block)
+            commit = commit_for(cur, block, pvs, bid)
+            block_store.save_block(block, parts, commit)
+            states[h] = new_state.marshal()
+            cur, last_commit = new_state, commit
+        return doc, state_db, block_store, states
+
+    def _fresh_app_at(self, block_store, height):
+        """A fresh kvstore advanced to `height` by re-running stored blocks."""
+        app = KVStoreApp()
+        for h in range(1, height + 1):
+            block = block_store.load_block(h)
+            for tx in block.data.txs:
+                app.deliver_tx(abci.RequestDeliverTx(tx=bytes(tx)))
+            app.commit(abci.RequestCommit())
+        return app
+
+    @pytest.mark.parametrize("state_behind", [0, 1])
+    @pytest.mark.parametrize("app_behind", [0, 1, 2, 3])
+    def test_handshake_matrix(self, state_behind, app_behind):
+        from tendermint_tpu.consensus.replay import Handshaker
+
+        doc, state_db, block_store, states = self._build_chain()
+        app = self._fresh_app_at(block_store, self.N - app_behind)
+        conn = MultiAppConn(LocalClientCreator(app))
+        conn.start()
+        st = State.unmarshal(states[self.N - state_behind])
+        hs = Handshaker(state_db, st, block_store, doc)
+        res_state = hs.handshake(conn)
+        expected = State.unmarshal(states[self.N])
+        assert res_state.last_block_height == self.N
+        assert res_state.app_hash == expected.app_hash
+        assert app.height == self.N
+        # one tx per block: if any block were double-applied, size would be > N
+        assert app.size == self.N
+        conn.stop()
+
+    def test_app_ahead_of_store_rejected(self):
+        from tendermint_tpu.consensus.replay import Handshaker, ReplayError
+
+        doc, state_db, block_store, states = self._build_chain()
+        app = self._fresh_app_at(block_store, self.N)
+        app.commit(abci.RequestCommit())  # app one past the store
+        conn = MultiAppConn(LocalClientCreator(app))
+        conn.start()
+        hs = Handshaker(state_db, State.unmarshal(states[self.N]), block_store, doc)
+        with pytest.raises(ReplayError, match="ahead of store"):
+            hs.handshake(conn)
+        conn.stop()
+
+    def test_store_too_far_ahead_of_state_rejected(self):
+        from tendermint_tpu.consensus.replay import Handshaker, ReplayError
+
+        doc, state_db, block_store, states = self._build_chain()
+        app = self._fresh_app_at(block_store, self.N)
+        conn = MultiAppConn(LocalClientCreator(app))
+        conn.start()
+        hs = Handshaker(state_db, State.unmarshal(states[self.N - 2]), block_store, doc)
+        with pytest.raises(ReplayError, match="more than one ahead"):
+            hs.handshake(conn)
+        conn.stop()
+
+    def test_app_hash_mismatch_halts(self):
+        from tendermint_tpu.consensus.replay import Handshaker, ReplayError
+
+        doc, state_db, block_store, states = self._build_chain()
+        app = self._fresh_app_at(block_store, self.N)
+        app.state[b"rogue"] = b"entry"  # nondeterministic app divergence
+        conn = MultiAppConn(LocalClientCreator(app))
+        conn.start()
+        hs = Handshaker(state_db, State.unmarshal(states[self.N]), block_store, doc)
+        with pytest.raises(ReplayError, match="app hash mismatch"):
+            hs.handshake(conn)
+        conn.stop()
+
+    def test_init_chain_consensus_params_applied(self):
+        from tendermint_tpu.consensus.replay import Handshaker
+
+        class ParamApp(KVStoreApp):
+            def __init__(self):
+                super().__init__()
+                self.seen_params = None
+
+            def init_chain(self, req):
+                self.seen_params = req.consensus_params
+                return abci.ResponseInitChain(
+                    consensus_params=abci.ConsensusParams(
+                        block_size=abci.BlockSizeParams(max_bytes=12345, max_gas=99)
+                    )
+                )
+
+        doc, pvs = make_genesis(1)
+        st = state_from_genesis(doc)
+        state_db = MemDB()
+        store.save_state(state_db, st)
+        block_store = BlockStore(MemDB())
+        app = ParamApp()
+        conn = MultiAppConn(LocalClientCreator(app))
+        conn.start()
+        hs = Handshaker(state_db, st, block_store, doc)
+        res_state = hs.handshake(conn)
+        # genesis params were sent to the app...
+        assert app.seen_params is not None
+        assert (
+            app.seen_params.block_size.max_bytes
+            == doc.consensus_params.block_size.max_bytes
+        )
+        # ...and the app's override came back and stuck (also persisted)
+        assert res_state.consensus_params.block_size.max_bytes == 12345
+        assert res_state.consensus_params.block_size.max_gas == 99
+        assert store.load_state(state_db).consensus_params.block_size.max_bytes == 12345
+        conn.stop()
