@@ -1,0 +1,221 @@
+"""Vectorized SHA-512 + scalar-field reduction for the ed25519 host prologue.
+
+The reference hashes one vote's sign-bytes at a time inside each serial verify
+(`/root/reference/crypto/ed25519/ed25519.go:151` via x/crypto sha512). Here the
+whole batch's `h = SHA-512(R || A || M) mod L` is produced with numpy-vectorized
+SHA-512 (one (N,) uint64 lane per message, 80 rounds shared) and a vectorized
+Barrett reduction in radix-2^13 limbs — no per-signature Python in the hot path
+once message lengths are uniform (vote sign-bytes are fixed-size per chain).
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import numpy as np
+
+L = (1 << 252) + 27742317777372353535851937790883648493
+
+# SHA-512 round constants (FIPS 180-4).
+_K = np.array(
+    [
+        0x428A2F98D728AE22, 0x7137449123EF65CD, 0xB5C0FBCFEC4D3B2F, 0xE9B5DBA58189DBBC,
+        0x3956C25BF348B538, 0x59F111F1B605D019, 0x923F82A4AF194F9B, 0xAB1C5ED5DA6D8118,
+        0xD807AA98A3030242, 0x12835B0145706FBE, 0x243185BE4EE4B28C, 0x550C7DC3D5FFB4E2,
+        0x72BE5D74F27B896F, 0x80DEB1FE3B1696B1, 0x9BDC06A725C71235, 0xC19BF174CF692694,
+        0xE49B69C19EF14AD2, 0xEFBE4786384F25E3, 0x0FC19DC68B8CD5B5, 0x240CA1CC77AC9C65,
+        0x2DE92C6F592B0275, 0x4A7484AA6EA6E483, 0x5CB0A9DCBD41FBD4, 0x76F988DA831153B5,
+        0x983E5152EE66DFAB, 0xA831C66D2DB43210, 0xB00327C898FB213F, 0xBF597FC7BEEF0EE4,
+        0xC6E00BF33DA88FC2, 0xD5A79147930AA725, 0x06CA6351E003826F, 0x142929670A0E6E70,
+        0x27B70A8546D22FFC, 0x2E1B21385C26C926, 0x4D2C6DFC5AC42AED, 0x53380D139D95B3DF,
+        0x650A73548BAF63DE, 0x766A0ABB3C77B2A8, 0x81C2C92E47EDAEE6, 0x92722C851482353B,
+        0xA2BFE8A14CF10364, 0xA81A664BBC423001, 0xC24B8B70D0F89791, 0xC76C51A30654BE30,
+        0xD192E819D6EF5218, 0xD69906245565A910, 0xF40E35855771202A, 0x106AA07032BBD1B8,
+        0x19A4C116B8D2D0C8, 0x1E376C085141AB53, 0x2748774CDF8EEB99, 0x34B0BCB5E19B48A8,
+        0x391C0CB3C5C95A63, 0x4ED8AA4AE3418ACB, 0x5B9CCA4F7763E373, 0x682E6FF3D6B2B8A3,
+        0x748F82EE5DEFB2FC, 0x78A5636F43172F60, 0x84C87814A1F0AB72, 0x8CC702081A6439EC,
+        0x90BEFFFA23631E28, 0xA4506CEBDE82BDE9, 0xBEF9A3F7B2C67915, 0xC67178F2E372532B,
+        0xCA273ECEEA26619C, 0xD186B8C721C0C207, 0xEADA7DD6CDE0EB1E, 0xF57D4F7FEE6ED178,
+        0x06F067AA72176FBA, 0x0A637DC5A2C898A6, 0x113F9804BEF90DAE, 0x1B710B35131C471B,
+        0x28DB77F523047D84, 0x32CAAB7B40C72493, 0x3C9EBE0A15C9BEBC, 0x431D67C49C100D4C,
+        0x4CC5D4BECB3E42B6, 0x597F299CFC657E2A, 0x5FCB6FAB3AD6FAEC, 0x6C44198C4A475817,
+    ],
+    dtype=np.uint64,
+)
+
+_H0 = np.array(
+    [
+        0x6A09E667F3BCC908, 0xBB67AE8584CAA73B, 0x3C6EF372FE94F82B, 0xA54FF53A5F1D36F1,
+        0x510E527FADE682D1, 0x9B05688C2B3E6C1F, 0x1F83D9ABFB41BD6B, 0x5BE0CD19137E2179,
+    ],
+    dtype=np.uint64,
+)
+
+
+def _rotr(x: np.ndarray, n: int) -> np.ndarray:
+    n = np.uint64(n)
+    return (x >> n) | (x << (np.uint64(64) - n))
+
+
+def sha512_batch(data: np.ndarray, lengths: int) -> np.ndarray:
+    """SHA-512 of N equal-length messages.
+
+    data: (N, lengths) uint8. Returns (N, 64) uint8 digests.
+    """
+    n = data.shape[0]
+    # pad: 0x80, zeros, 16-byte big-endian bit length
+    blocks = (lengths + 1 + 16 + 127) // 128
+    padded = np.zeros((n, blocks * 128), dtype=np.uint8)
+    padded[:, :lengths] = data
+    padded[:, lengths] = 0x80
+    bitlen = lengths * 8
+    blen = bitlen.to_bytes(16, "big")
+    padded[:, -16:] = np.frombuffer(blen, dtype=np.uint8)
+
+    # big-endian 64-bit words: (N, blocks, 16)
+    words = padded.reshape(n, blocks, 16, 8)
+    w64 = np.zeros((n, blocks, 16), dtype=np.uint64)
+    for b in range(8):
+        w64 = (w64 << np.uint64(8)) | words[:, :, :, b].astype(np.uint64)
+
+    state = np.broadcast_to(_H0, (n, 8)).copy()
+    with np.errstate(over="ignore"):
+        for blk in range(blocks):
+            w = [w64[:, blk, t] for t in range(16)]
+            for t in range(16, 80):
+                s0 = _rotr(w[t - 15], 1) ^ _rotr(w[t - 15], 8) ^ (w[t - 15] >> np.uint64(7))
+                s1 = _rotr(w[t - 2], 19) ^ _rotr(w[t - 2], 61) ^ (w[t - 2] >> np.uint64(6))
+                w.append(w[t - 16] + s0 + w[t - 7] + s1)
+            a, b_, c, d, e, f, g, h = (state[:, i].copy() for i in range(8))
+            for t in range(80):
+                S1 = _rotr(e, 14) ^ _rotr(e, 18) ^ _rotr(e, 41)
+                ch = (e & f) ^ (~e & g)
+                t1 = h + S1 + ch + _K[t] + w[t]
+                S0 = _rotr(a, 28) ^ _rotr(a, 34) ^ _rotr(a, 39)
+                maj = (a & b_) ^ (a & c) ^ (b_ & c)
+                t2 = S0 + maj
+                h, g, f, e, d, c, b_, a = g, f, e, d + t1, c, b_, a, t1 + t2
+            for i, v in enumerate((a, b_, c, d, e, f, g, h)):
+                state[:, i] += v
+
+    # big-endian bytes out
+    out = np.zeros((n, 64), dtype=np.uint8)
+    for i in range(8):
+        v = state[:, i]
+        for b in range(8):
+            out[:, 8 * i + b] = ((v >> np.uint64(56 - 8 * b)) & np.uint64(0xFF)).astype(np.uint8)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Barrett reduction of 512-bit digests mod L, radix 2^13, vectorized.
+# ---------------------------------------------------------------------------
+
+_BITS = 13
+_MASK = (1 << _BITS) - 1
+_HL = 40  # 512-bit digest = 40 limbs
+_LL = 20  # L < 2^260 = b^20
+_QL = 21  # quotient-estimate limb count
+_MU = (1 << (_BITS * 2 * _LL)) // L  # floor(b^40 / L), 21 limbs
+
+def _int_limbs(x: int, n: int) -> np.ndarray:
+    return np.array([(x >> (_BITS * i)) & _MASK for i in range(n)], dtype=np.uint64)
+
+_MU_LIMBS = _int_limbs(_MU, _QL + 1)
+_L_LIMBS = _int_limbs(L, _LL)
+# b^21 - L  (for the borrow-free conditional subtract)
+_LC_LIMBS = _int_limbs((1 << (_BITS * _QL)) - L, _QL)
+
+
+def _bytes_to_limbs_le(b: np.ndarray, nlimb: int) -> np.ndarray:
+    """(N, nbytes) uint8 little-endian -> (N, nlimb) uint64 radix-2^13."""
+    bits = np.unpackbits(b, axis=1, bitorder="little").astype(np.uint64)
+    need = nlimb * _BITS
+    if bits.shape[1] < need:
+        bits = np.pad(bits, ((0, 0), (0, need - bits.shape[1])))
+    w = (np.uint64(1) << np.arange(_BITS, dtype=np.uint64))
+    out = np.zeros((b.shape[0], nlimb), dtype=np.uint64)
+    for i in range(nlimb):
+        out[:, i] = bits[:, _BITS * i : _BITS * (i + 1)] @ w
+    return out
+
+
+def _limbs_to_bytes_le(limbs: np.ndarray, nbytes: int) -> np.ndarray:
+    n, nl = limbs.shape
+    lo = (limbs & np.uint64(0xFF)).astype(np.uint8)
+    hi = ((limbs >> np.uint64(8)) & np.uint64(0xFF)).astype(np.uint8)
+    pairs = np.stack([lo, hi], axis=2).reshape(n, nl * 2)  # 16-bit LE per limb
+    bits = np.unpackbits(pairs, axis=1, bitorder="little").reshape(n, nl, 16)
+    bits = bits[:, :, :_BITS].reshape(n, nl * _BITS)
+    bits = bits[:, : nbytes * 8]
+    return np.packbits(bits, axis=1, bitorder="little")
+
+
+def _mul_limbs(a: np.ndarray, b_const: np.ndarray) -> np.ndarray:
+    """(N, A) uint64 × (B,) const -> (N, A+B) uint64, carried to radix."""
+    n, al = a.shape
+    bl = b_const.shape[0]
+    prod = np.zeros((n, al + bl), dtype=np.uint64)
+    for j in range(bl):
+        if int(b_const[j]) == 0:
+            continue
+        prod[:, j : j + al] += a * b_const[j]
+    # carry (products <= 8191^2 * 40 ~ 2^38, safe in u64)
+    carry = np.zeros(n, dtype=np.uint64)
+    for i in range(al + bl):
+        v = prod[:, i] + carry
+        prod[:, i] = v & np.uint64(_MASK)
+        carry = v >> np.uint64(_BITS)
+    return prod
+
+
+def reduce_mod_l(digests: np.ndarray) -> np.ndarray:
+    """(N, 64) uint8 SHA-512 digests (little-endian ints) -> (N, 32) uint8 of
+    the digest mod L (little-endian)."""
+    n = digests.shape[0]
+    h = _bytes_to_limbs_le(digests, _HL)  # < b^40
+    # Barrett: q1 = h >> b^(k-1), k = 20
+    q1 = h[:, _LL - 1 :]  # 21 limbs
+    q2 = _mul_limbs(q1, _MU_LIMBS)  # 43 limbs
+    q3 = q2[:, _QL :]  # >> b^21
+    q3l = _mul_limbs(q3, _L_LIMBS)[:, :_QL]  # mod b^21
+    # r = (h - q3*L) mod b^21, guaranteed in [0, 3L)
+    r = np.zeros((n, _QL), dtype=np.uint64)
+    borrow = np.zeros(n, dtype=np.uint64)
+    for i in range(_QL):
+        v = h[:, i] - q3l[:, i] - borrow
+        borrow = (v >> np.uint64(63)) & np.uint64(1)  # negative wrapped
+        # 2^64 ≡ 0 (mod 2^13): masking the wrapped value is the mod-b residue
+        r[:, i] = v & np.uint64(_MASK)
+    # conditional subtract L twice: t = r + (b^21 - L); carry-out of top limb
+    for _ in range(2):
+        t = r + _LC_LIMBS
+        carry = np.zeros(n, dtype=np.uint64)
+        for i in range(_QL):
+            v = t[:, i] + carry
+            t[:, i] = v & np.uint64(_MASK)
+            carry = v >> np.uint64(_BITS)
+        ge = carry > 0  # r >= L
+        r = np.where(ge[:, None], t, r)
+    return _limbs_to_bytes_le(r, 32)
+
+
+def compute_h_batch(r32: np.ndarray, pubs: np.ndarray, msgs: Sequence[bytes]) -> np.ndarray:
+    """h = SHA-512(R||A||M) mod L for the whole batch -> (N, 32) uint8 LE.
+
+    Uniform-length messages take the fully-vectorized path; mixed lengths are
+    grouped by length (each group vectorized).
+    """
+    n = r32.shape[0]
+    out = np.zeros((n, 32), dtype=np.uint8)
+    lens = np.array([len(m) for m in msgs])
+    for ln in np.unique(lens):
+        idx = np.nonzero(lens == ln)[0]
+        data = np.zeros((len(idx), 64 + int(ln)), dtype=np.uint8)
+        data[:, :32] = r32[idx]
+        data[:, 32:64] = pubs[idx]
+        for row, i in enumerate(idx):
+            data[row, 64:] = np.frombuffer(msgs[i], dtype=np.uint8)
+        digests = sha512_batch(data, 64 + int(ln))
+        out[idx] = reduce_mod_l(digests)
+    return out
