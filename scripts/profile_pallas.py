@@ -1,0 +1,96 @@
+"""Per-stage timing of the Pallas ed25519 verify path on the real chip."""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import numpy as np
+
+from tendermint_tpu.crypto import ed25519 as ed
+from tendermint_tpu.ops import ed25519_pallas as pk
+
+N = 10_000
+MSG_LEN = 110
+
+rng = np.random.default_rng(42)
+seeds = rng.bytes(32 * N)
+pubs = np.zeros((N, 32), np.uint8)
+sigs = np.zeros((N, 64), np.uint8)
+msgs = []
+for i in range(N):
+    priv = ed.gen_privkey(seeds[32 * i : 32 * (i + 1)])
+    msg = bytes([i & 0xFF, (i >> 8) & 0xFF]) * (MSG_LEN // 2)
+    pubs[i] = np.frombuffer(priv[32:], np.uint8)
+    sigs[i] = np.frombuffer(ed.sign(priv, msg), np.uint8)
+    msgs.append(msg)
+
+print("devices:", jax.devices())
+
+# end-to-end
+ok = pk.verify_batch(pubs, msgs, sigs)
+assert ok.all()
+ts = []
+for _ in range(5):
+    t0 = time.perf_counter()
+    pk.verify_batch(pubs, msgs, sigs)
+    ts.append(time.perf_counter() - t0)
+print(f"end-to-end verify_batch: {np.median(ts)*1e3:.1f} ms")
+
+# stage split: host packing vs prologue vs ladder
+neg_ax, ay, valid = pk._decompress_valset(pubs)
+n = N
+b = pk._bucket(n)
+total = 64 + MSG_LEN
+nblocks = (total + 1 + 16 + 127) // 128
+padded = np.zeros((b, nblocks * 128), dtype=np.uint8)
+padded[:n, :32] = sigs[:, :32]
+padded[:n, 32:64] = pubs
+m = np.frombuffer(b"".join(msgs), dtype=np.uint8).reshape(n, MSG_LEN)
+padded[:n, 64:total] = m
+padded[:, total] = 0x80
+padded[:, -16:] = np.frombuffer((total * 8).to_bytes(16, "big"), np.uint8)
+msg_words = padded.reshape(b, -1, 4)[:, :, ::-1].reshape(b, -1)
+msg_words = np.ascontiguousarray(msg_words).view("<u4").astype(np.uint32)
+sig_words = np.ascontiguousarray(sigs).view("<u4").astype(np.uint32)
+
+import jax.numpy as jnp
+
+negax_d = jnp.asarray(pk._pad_rows(neg_ax, b)).T
+ay_d = jnp.asarray(pk._pad_rows(ay, b)).T
+sigw_d = jnp.asarray(pk._pad_rows(sig_words, b)).T
+msgw_d = jnp.asarray(msg_words).T
+
+prologue = jax.jit(lambda mw, sw: pk._prologue_call(mw, sw))
+ladder = jax.jit(
+    lambda nx, ayy, digs, digh, rl, rs: pk._ladder_call(nx, ayy, digs, digh, rl, rs)
+)
+
+digs, digh, rlimb, rsign = jax.block_until_ready(prologue(msgw_d, sigw_d))
+out = jax.block_until_ready(ladder(negax_d, ay_d, digs, digh, rlimb, rsign))
+
+for name, fn, args in [
+    ("prologue", prologue, (msgw_d, sigw_d)),
+    ("ladder", ladder, (negax_d, ay_d, digs, digh, rlimb, rsign)),
+]:
+    ts = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(*args))
+        ts.append(time.perf_counter() - t0)
+    print(f"{name}: {np.median(ts)*1e3:.1f} ms")
+
+# host-side packing cost
+ts = []
+for _ in range(5):
+    t0 = time.perf_counter()
+    pk._decompress_valset(pubs)
+    padded2 = np.zeros((b, nblocks * 128), dtype=np.uint8)
+    padded2[:n, :32] = sigs[:, :32]
+    padded2[:n, 32:64] = pubs
+    padded2[:n, 64:total] = m
+    mw = padded2.reshape(b, -1, 4)[:, :, ::-1].reshape(b, -1)
+    mw = np.ascontiguousarray(mw).view("<u4").astype(np.uint32)
+    ts.append(time.perf_counter() - t0)
+print(f"host packing (cached decompress): {np.median(ts)*1e3:.1f} ms")

@@ -253,7 +253,16 @@ class Handshaker:
                 if h > 1:
                     try:
                         hist_vals = sm_store.load_validators(self.state_db, h - 1)
-                    except Exception:
+                    except sm_store.NoValSetForHeightError:
+                        # acceptable fallback (the reference uses
+                        # state.LastValidators unconditionally, replay.go TODO)
+                        # but wrong if the valset changed — warn loudly so an
+                        # app-hash mismatch downstream has a visible cause
+                        self.logger.info(
+                            "no stored valset for height %d; falling back to "
+                            "state.last_validators (wrong if valset changed)",
+                            h - 1,
+                        )
                         hist_vals = state.last_validators
                 else:
                     hist_vals = state.last_validators  # empty LastCommit at h=1
@@ -268,6 +277,8 @@ class Handshaker:
                 self.logger.info("applying block %d (app + state)", h)
                 block_exec = BlockExecutor(self.state_db, proxy_app.consensus)
                 meta = self.store.load_block_meta(h)
+                if meta is None:
+                    raise ReplayError(f"missing block meta {h} in store")
                 state = block_exec.apply_block(state, meta.block_id, block)
                 app_hash = state.app_hash
             self.n_blocks += 1

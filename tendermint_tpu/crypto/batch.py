@@ -11,8 +11,10 @@ window of heights — and dispatch them in ONE call.
 
 Backends:
   * HostBatchVerifier  — serial host loop (CPU oracle; always available).
-  * TPUBatchVerifier   — tendermint_tpu.ops.ed25519_verify batched JAX kernel for
-    ed25519 items; non-ed25519 items (secp256k1, multisig) fall back to host.
+  * TPUBatchVerifier   — device path. On a real TPU it dispatches the fused
+    Pallas pipeline (ops/ed25519_pallas); on CPU or when a mesh is given it
+    uses the portable XLA kernel (ops/ed25519_verify, shard_map-able).
+    Non-ed25519 items (secp256k1, multisig) fall back to host.
 
 Accept/reject is bit-exact across backends (tests/test_ops_ed25519.py).
 """
@@ -49,17 +51,43 @@ class HostBatchVerifier:
         )
 
 
+def _find_tpu_device():
+    """The real chip, if reachable (even when the default backend is CPU)."""
+    import jax
+
+    try:
+        return jax.devices("tpu")[0]
+    except Exception:
+        return None
+
+
 class TPUBatchVerifier:
-    """Batched device verification through the JAX kernel (ops/ed25519_verify)."""
+    """Batched device verification.
+
+    backend: "pallas" (fused kernel, needs a real TPU), "xla" (portable,
+    mesh-shardable), or None = pick pallas when a TPU is reachable and no
+    mesh was requested.
+    """
 
     name = "tpu"
 
-    def __init__(self, mesh=None):
-        # deferred import: keep jax out of pure-host users
-        from tendermint_tpu.ops import ed25519_verify as kernel
-
-        self._kernel = kernel
+    def __init__(self, mesh=None, backend: Optional[str] = None):
         self._mesh = mesh
+        self._tpu = None
+        if backend is None:
+            self._tpu = _find_tpu_device() if mesh is None else None
+            backend = "pallas" if self._tpu is not None else "xla"
+        elif backend == "pallas":
+            self._tpu = _find_tpu_device()
+            if self._tpu is None:
+                raise RuntimeError("pallas backend requires a reachable TPU")
+        self.backend = backend
+        # deferred imports: keep jax out of pure-host users
+        if backend == "pallas":
+            from tendermint_tpu.ops import ed25519_pallas as kernel
+        else:
+            from tendermint_tpu.ops import ed25519_verify as kernel
+        self._kernel = kernel
 
     def verify_ed25519(self, items: Sequence[SigItem]) -> np.ndarray:
         if len(items) == 0:
@@ -71,9 +99,14 @@ class TPUBatchVerifier:
             b"".join(it.sig for it in items), dtype=np.uint8
         ).reshape(len(items), 64)
         msgs = [it.msg for it in items]
-        return np.asarray(
-            self._kernel.verify_batch(pubs, msgs, sigs, mesh=self._mesh), dtype=bool
-        )
+        if self.backend == "pallas":
+            import jax
+
+            dev = None if jax.default_backend() == "tpu" else self._tpu
+            ok = self._kernel.verify_batch(pubs, msgs, sigs, device=dev)
+        else:
+            ok = self._kernel.verify_batch(pubs, msgs, sigs, mesh=self._mesh)
+        return np.asarray(ok, dtype=bool)
 
 
 _lock = threading.Lock()

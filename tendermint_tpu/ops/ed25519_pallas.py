@@ -8,8 +8,9 @@ shard_map path). The reference verifies serially on host
 decompression — SHA-512 of the sign-bytes, reduction mod L, scalar digit
 extraction, the double-scalar ladder, and the canonical-encoding compare —
 runs on device in one jit, with the ladder as a single VMEM-resident Pallas
-kernel (the XLA version materializes every field-op intermediate to HBM and is
-~50x slower; measured 825ms -> ~5ms on a v5e-1 for 12288 signatures).
+kernel (the XLA version materializes every field-op intermediate to HBM; on the
+v5e-1 bench chip this path verifies 10k signatures in ~4.5x less wall-clock
+than the XLA kernel — see bench.py for the driver-captured number).
 
 Algorithm (per 128-lane block, batch on lanes, limbs on sublanes):
 
@@ -326,20 +327,21 @@ def _ladder_kernel(consts_ref, negax_ref, ay_ref, digs_ref, digh_ref,
     out_ref[:] = ok.astype(jnp.uint32)
 
 
-def _ladder_call(negax, ay, digs, digh, rlimb, rsign, *, interpret=False):
-    """negax/ay/rlimb (20, N), digs/digh (64, N), rsign (1, N); N % LANES == 0."""
+def _ladder_call(negax, ay, digs, digh, rlimb, rsign, *, interpret=False,
+                 lanes=LANES):
+    """negax/ay/rlimb (20, N), digs/digh (64, N), rsign (1, N); N % lanes == 0."""
     n = negax.shape[1]
     cspec = pl.BlockSpec((NLIMB, 52), lambda i: (0, 0), memory_space=pltpu.VMEM)
-    spec20 = pl.BlockSpec((NLIMB, LANES), lambda i: (0, i), memory_space=pltpu.VMEM)
-    spec64 = pl.BlockSpec((NWIN, LANES), lambda i: (0, i), memory_space=pltpu.VMEM)
-    spec1 = pl.BlockSpec((1, LANES), lambda i: (0, i), memory_space=pltpu.VMEM)
+    spec20 = pl.BlockSpec((NLIMB, lanes), lambda i: (0, i), memory_space=pltpu.VMEM)
+    spec64 = pl.BlockSpec((NWIN, lanes), lambda i: (0, i), memory_space=pltpu.VMEM)
+    spec1 = pl.BlockSpec((1, lanes), lambda i: (0, i), memory_space=pltpu.VMEM)
     return pl.pallas_call(
         _ladder_kernel,
         out_shape=jax.ShapeDtypeStruct((1, n), jnp.uint32),
-        grid=(n // LANES,),
+        grid=(n // lanes,),
         in_specs=[cspec, spec20, spec20, spec64, spec64, spec20, spec1],
         out_specs=spec1,
-        scratch_shapes=[pltpu.VMEM((NLIMB, LANES), jnp.uint32)] * 2,
+        scratch_shapes=[pltpu.VMEM((NLIMB, lanes), jnp.uint32)] * 2,
         interpret=interpret,
     )(jnp.asarray(_CONSTS), negax, ay, digs, digh, rlimb, rsign)
 
@@ -594,15 +596,15 @@ def _prologue_kernel(k_ref, msgw_ref, sigw_ref,
     rsign_ref[:] = r_words[7] >> 31
 
 
-def _prologue_call(msg_words, sig_words, *, interpret=False):
+def _prologue_call(msg_words, sig_words, *, interpret=False, lanes=LANES):
     """msg_words (nblocks*32, N) BE uint32; sig_words (16, N) LE uint32."""
     rows, n = msg_words.shape
-    mspec = pl.BlockSpec((rows, LANES), lambda i: (0, i), memory_space=pltpu.VMEM)
-    sspec = pl.BlockSpec((16, LANES), lambda i: (0, i), memory_space=pltpu.VMEM)
+    mspec = pl.BlockSpec((rows, lanes), lambda i: (0, i), memory_space=pltpu.VMEM)
+    sspec = pl.BlockSpec((16, lanes), lambda i: (0, i), memory_space=pltpu.VMEM)
     kspec = pl.BlockSpec((80, 2), lambda i: (0, 0), memory_space=pltpu.VMEM)
-    spec64 = pl.BlockSpec((NWIN, LANES), lambda i: (0, i), memory_space=pltpu.VMEM)
-    spec20 = pl.BlockSpec((NLIMB, LANES), lambda i: (0, i), memory_space=pltpu.VMEM)
-    spec1 = pl.BlockSpec((1, LANES), lambda i: (0, i), memory_space=pltpu.VMEM)
+    spec64 = pl.BlockSpec((NWIN, lanes), lambda i: (0, i), memory_space=pltpu.VMEM)
+    spec20 = pl.BlockSpec((NLIMB, lanes), lambda i: (0, i), memory_space=pltpu.VMEM)
+    spec1 = pl.BlockSpec((1, lanes), lambda i: (0, i), memory_space=pltpu.VMEM)
     return pl.pallas_call(
         _prologue_kernel,
         out_shape=[
@@ -611,24 +613,67 @@ def _prologue_call(msg_words, sig_words, *, interpret=False):
             jax.ShapeDtypeStruct((NLIMB, n), jnp.uint32),
             jax.ShapeDtypeStruct((1, n), jnp.uint32),
         ],
-        grid=(n // LANES,),
+        grid=(n // lanes,),
         in_specs=[kspec, mspec, sspec],
         out_specs=[spec64, spec64, spec20, spec1],
-        scratch_shapes=[pltpu.VMEM((160, LANES), jnp.uint32)],
+        scratch_shapes=[pltpu.VMEM((160, lanes), jnp.uint32)],
         interpret=interpret,
     )(jnp.asarray(_K_PAIRS), msg_words, sig_words)
 
 
-@partial(jax.jit, static_argnames=("interpret",))
-def _device_verify(negax, ay, sig_words, msg_words, interpret=False):
+def _device_verify(negax, ay, sig_words, msg_words, interpret=False,
+                   lanes=LANES):
     """negax/ay (N, 20) uint32; sig_words (N, 16) uint32 LE; msg_words
     (N, nblocks*32) uint32 BE padded SHA-512 input. Returns (N,) bool."""
     digs, digh, rlimb, rsign = _prologue_call(
-        msg_words.T, sig_words.T, interpret=interpret
+        msg_words.T, sig_words.T, interpret=interpret, lanes=lanes
     )
     ok = _ladder_call(
-        negax.T, ay.T, digs, digh, rlimb, rsign, interpret=interpret
+        negax.T, ay.T, digs, digh, rlimb, rsign, interpret=interpret, lanes=lanes
     )
+    return ok[0].astype(bool)
+
+
+# Compiled entry for the real-device path. In interpret mode the plain
+# function is called eagerly instead: tracing the interpreted kernels into one
+# jit graph explodes into thousands of scalar XLA ops (a 6-minute CPU compile).
+_device_verify_jit = partial(jax.jit, static_argnames=("interpret", "lanes"))(
+    _device_verify
+)
+
+
+@partial(jax.jit, static_argnames=("lanes",))
+def _device_verify_packed(negax, ay, pub_words, sig_words, tmpl, vidx, vwords,
+                          lanes=LANES):
+    """Transfer-minimizing verify: the padded SHA-512 input is ASSEMBLED ON
+    DEVICE instead of shipped over the wire.
+
+    The bench chip sits behind a network tunnel (~100ms dispatch round-trip,
+    single-digit MB/s host->device), so bytes on the wire — not FLOPs —
+    dominate wall clock. Steady-state per-signature transfer here is 64B of
+    signature + ~16B of message words that actually differ across the batch
+    (for commit verification: the fixed64 timestamp), against ~480B for the
+    naive path. Pubkey limbs + compressed words are device-cached per
+    validator set (_upload_valset).
+
+    negax/ay (b, 20) u32 limbs; pub_words (b, 8) / sig_words (b, 16) LE u32;
+    tmpl (rows,) BE u32 — padded SHA input of batch row 0; vidx (k,) i32 —
+    word rows >= 16 whose value varies per signature; vwords (b, k) BE u32 —
+    those rows' values. Rows 0..15 (R || A) always come from sig/pub words.
+    """
+    b = negax.shape[0]
+    rows = tmpl.shape[0]
+
+    def bswap(x):
+        return ((x >> 24) | ((x >> 8) & 0xFF00)
+                | ((x << 8) & 0xFF0000) | (x << 24))
+
+    mw = jnp.broadcast_to(tmpl[:, None], (rows, b))
+    mw = mw.at[0:8, :].set(bswap(sig_words[:, 0:8].T))
+    mw = mw.at[8:16, :].set(bswap(pub_words.T))
+    mw = mw.at[vidx, :].set(vwords.T)
+    digs, digh, rlimb, rsign = _prologue_call(mw, sig_words.T, lanes=lanes)
+    ok = _ladder_call(negax.T, ay.T, digs, digh, rlimb, rsign, lanes=lanes)
     return ok[0].astype(bool)
 
 
@@ -672,8 +717,35 @@ def _pad_rows(a: np.ndarray, b: int) -> np.ndarray:
     )
 
 
-def _bucket(n: int) -> int:
-    b = LANES
+_dev_valset_cache: dict = {}
+_DEV_VALSET_CACHE_MAX = 32
+
+
+def _upload_valset(pubs, neg_ax, ay, b, device):
+    """Device-resident (negax, ay, pub_words) padded to bucket b, cached per
+    (valset, bucket, device). Commit verification reuses the same validator
+    set every height, so after the first call the pubkey material never
+    crosses the tunnel again."""
+    key = (hashlib.sha256(pubs.tobytes()).digest(), b,
+           device if device is not None else "default")
+    hit = _dev_valset_cache.get(key)
+    if hit is not None:
+        return hit
+    put = (lambda a: jax.device_put(a, device)) if device is not None else jnp.asarray
+    pub_words = np.ascontiguousarray(pubs).view("<u4").astype(np.uint32)
+    entry = (
+        put(_pad_rows(neg_ax, b)),
+        put(_pad_rows(ay, b)),
+        put(_pad_rows(pub_words, b)),
+    )
+    if len(_dev_valset_cache) >= _DEV_VALSET_CACHE_MAX:
+        _dev_valset_cache.clear()
+    _dev_valset_cache[key] = entry
+    return entry
+
+
+def _bucket(n: int, lanes: int = LANES) -> int:
+    b = lanes
     while b < n and b < 4096:
         b *= 2
     if n <= b:
@@ -682,9 +754,11 @@ def _bucket(n: int) -> int:
 
 
 def verify_batch(pubs: np.ndarray, msgs: Sequence[bytes], sigs: np.ndarray,
-                 interpret: bool = False) -> np.ndarray:
+                 interpret: bool = False, device=None) -> np.ndarray:
     """Go-exact batched verify on the Pallas path. Same contract as
-    ops.ed25519_verify.verify_batch."""
+    ops.ed25519_verify.verify_batch. `device` pins the dispatch to a specific
+    jax device (used by tests that run on the real chip while the default
+    backend is the virtual CPU mesh)."""
     pubs = np.ascontiguousarray(pubs, dtype=np.uint8)
     sigs = np.ascontiguousarray(sigs, dtype=np.uint8)
     n = pubs.shape[0]
@@ -700,16 +774,81 @@ def verify_batch(pubs: np.ndarray, msgs: Sequence[bytes], sigs: np.ndarray,
         idx = np.nonzero(lens == ln)[0]
         out[idx] = _verify_uniform(
             pubs[idx], [msgs[i] for i in idx], sigs[idx],
-            neg_ax[idx], ay[idx], valid[idx], int(ln), interpret,
+            neg_ax[idx], ay[idx], valid[idx], int(ln), interpret, device,
         )
     return out
 
 
-def _verify_uniform(pubs, msgs, sigs, neg_ax, ay, valid, ln, interpret):
+def _verify_uniform(pubs, msgs, sigs, neg_ax, ay, valid, ln, interpret,
+                    device=None):
     n = pubs.shape[0]
-    b = _bucket(n)
+    # interpret mode (CPU tests) has no tile-alignment constraint: shrink the
+    # lane count so the eager interpreter does 16x less padded work.
+    lanes = 8 if interpret else LANES
+    b = _bucket(n, lanes)
     total = 64 + ln  # R || A || M
     nblocks = (total + 1 + 16 + 127) // 128
+    rows = nblocks * 32
+
+    sig_words = np.ascontiguousarray(sigs).view("<u4").astype(np.uint32)
+    # zero invalid rows' scalars to keep device work defined
+    sig_words = sig_words.copy()
+    sig_words[~valid] = 0
+
+    put = (lambda a: jax.device_put(a, device)) if device is not None else jnp.asarray
+
+    if not interpret:
+        # packed path: ship only signatures + the message words that actually
+        # vary across the batch; everything else is device-cached or template
+        m = (
+            np.frombuffer(b"".join(msgs), dtype=np.uint8).reshape(n, ln)
+            if ln else np.zeros((n, 0), np.uint8)
+        )
+        # template = row 0's padded SHA input, as BE words
+        pad0 = np.zeros((nblocks * 128,), dtype=np.uint8)
+        pad0[:32] = sigs[0, :32]
+        pad0[32:64] = pubs[0]
+        pad0[64:total] = m[0]
+        pad0[total] = 0x80
+        pad0[-16:] = np.frombuffer((total * 8).to_bytes(16, "big"), np.uint8)
+        tmpl = (
+            np.ascontiguousarray(pad0.reshape(-1, 4)[:, ::-1].reshape(-1))
+            .view("<u4").astype(np.uint32)
+        )
+        # message byte columns that differ across the batch -> padded word rows
+        diff_cols = np.nonzero((m != m[0]).any(axis=0))[0]
+        vrows = np.unique((64 + diff_cols) // 4).astype(np.int32)
+        if vrows.size == 0:
+            vrows = np.array([16], np.int32)  # row 16 always exists (rows>=32)
+        k = int(vrows.size)
+        k_pad = 1 << (k - 1).bit_length()
+        # per-signature BE words at the varying rows
+        mpad = np.zeros((b, (rows - 16) * 4), dtype=np.uint8)
+        mpad[:n, : total - 64] = m
+        mpad[:, total - 64] = 0x80
+        mpad[:, -16:] = np.frombuffer((total * 8).to_bytes(16, "big"), np.uint8)
+        mwords = (
+            np.ascontiguousarray(mpad.reshape(b, -1, 4)[:, :, ::-1].reshape(b, -1))
+            .view("<u4").astype(np.uint32)
+        )
+        vwords = mwords[:, vrows - 16]
+        if k_pad > k:  # duplicate scatter rows carry identical values
+            vrows = np.concatenate([vrows, np.full((k_pad - k,), vrows[0], np.int32)])
+            vwords = np.concatenate(
+                [vwords, np.tile(vwords[:, :1], (1, k_pad - k))], axis=1
+            )
+        negax_d, ay_d, pubw_d = _upload_valset(pubs, neg_ax, ay, b, device)
+        ok = np.asarray(
+            _device_verify_packed(
+                negax_d, ay_d, pubw_d,
+                put(_pad_rows(sig_words, b)),
+                put(tmpl), put(vrows), put(vwords),
+                lanes=lanes,
+            )
+        )[:n]
+        return ok & valid
+
+    # reference path (interpret mode): full padded input assembled on host
     padded = np.zeros((b, nblocks * 128), dtype=np.uint8)
     padded[:n, :32] = sigs[:, :32]
     padded[:n, 32:64] = pubs
@@ -722,18 +861,14 @@ def _verify_uniform(pubs, msgs, sigs, neg_ax, ay, valid, ln, interpret):
     msg_words = padded.reshape(b, -1, 4)[:, :, ::-1].reshape(b, -1)
     msg_words = np.ascontiguousarray(msg_words).view("<u4").astype(np.uint32)
 
-    sig_words = np.ascontiguousarray(sigs).view("<u4").astype(np.uint32)
-    # zero invalid rows' scalars to keep device work defined
-    sig_words = sig_words.copy()
-    sig_words[~valid] = 0
-
     ok = np.asarray(
         _device_verify(
-            jnp.asarray(_pad_rows(neg_ax, b)),
-            jnp.asarray(_pad_rows(ay, b)),
-            jnp.asarray(_pad_rows(sig_words, b)),
-            jnp.asarray(msg_words),
+            put(_pad_rows(neg_ax, b)),
+            put(_pad_rows(ay, b)),
+            put(_pad_rows(sig_words, b)),
+            put(msg_words),
             interpret=interpret,
+            lanes=lanes,
         )
     )[:n]
     return ok & valid

@@ -18,6 +18,14 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 
 import jax
 
-jax.config.update("jax_platforms", "cpu")
+# Keep CPU as the default backend (8 virtual devices for sharding tests) but
+# also expose the real TPU chip when its tunnel is reachable — the Pallas
+# kernel tests dispatch to it explicitly (interpret mode is far too slow).
+try:
+    jax.config.update("jax_platforms", "cpu,axon")
+    jax.devices()
+    jax.devices("axon")
+except Exception:
+    jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

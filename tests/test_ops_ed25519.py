@@ -12,6 +12,26 @@ import pytest
 from tendermint_tpu.crypto import ed25519 as ed
 from tendermint_tpu.ops import ed25519_verify as kernel
 
+try:
+    import jax
+
+    _TPU = jax.devices("tpu")[0]
+except Exception:
+    _TPU = None
+
+# Every accept/reject test below runs against BOTH device backends. The Pallas
+# path needs the real chip (interpret mode takes minutes per call), so it is
+# exercised whenever the TPU tunnel is reachable and skipped otherwise.
+BACKENDS = ["xla"] + (["pallas"] if _TPU is not None else [])
+
+
+def _verify(backend, pubs, msgs, sigs):
+    if backend == "pallas":
+        from tendermint_tpu.ops import ed25519_pallas as pk
+
+        return pk.verify_batch(pubs, msgs, sigs, device=_TPU)
+    return kernel.verify_batch(pubs, msgs, sigs)
+
 
 def _mk(n, msg_len=110, seed0=1):
     """n valid (pub, msg, sig) triples."""
@@ -87,37 +107,38 @@ class TestFieldArithmetic:
             assert kernel.limbs_to_int(got[0]) == v % ed.P
 
 
+@pytest.mark.parametrize("backend", BACKENDS)
 class TestVerifyBatch:
-    def test_valid_batch(self):
+    def test_valid_batch(self, backend):
         pubs, msgs, sigs = _mk(9)
-        assert kernel.verify_batch(pubs, msgs, sigs).all()
+        assert _verify(backend, pubs, msgs, sigs).all()
 
-    def test_corruptions_rejected(self):
+    def test_corruptions_rejected(self, backend):
         pubs, msgs, sigs = _mk(8)
         for i, byte in enumerate([0, 15, 31, 32, 40, 63, 5, 20]):
             sigs[i, byte] ^= 1
-        got = kernel.verify_batch(pubs, msgs, sigs)
+        got = _verify(backend, pubs, msgs, sigs)
         assert got.tolist() == _oracle(pubs, msgs, sigs).tolist()
         assert not got.any()
 
-    def test_wrong_message(self):
+    def test_wrong_message(self, backend):
         pubs, msgs, sigs = _mk(4)
         msgs[2] = msgs[2] + b"!"
-        got = kernel.verify_batch(pubs, msgs, sigs)
+        got = _verify(backend, pubs, msgs, sigs)
         assert got.tolist() == [True, True, False, True]
 
-    def test_s_plus_L_accepted_top_bits_rejected(self):
+    def test_s_plus_L_accepted_top_bits_rejected(self, backend):
         """The Go malleability quirk must survive the device path."""
         pubs, msgs, sigs = _mk(2)
         s = int.from_bytes(sigs[0, 32:].tobytes(), "little") + ed.L
         assert s < 2**253
         sigs[0, 32:] = np.frombuffer(s.to_bytes(32, "little"), np.uint8)
         sigs[1, 63] |= 0x20  # top-bit check -> reject
-        got = kernel.verify_batch(pubs, msgs, sigs)
+        got = _verify(backend, pubs, msgs, sigs)
         assert got.tolist() == [True, False]
         assert got.tolist() == _oracle(pubs, msgs, sigs).tolist()
 
-    def test_noncanonical_pubkey_and_R(self):
+    def test_noncanonical_pubkey_and_R(self, backend):
         """Forge accept-cases in the non-canonical zone and check parity."""
         # find small-y decompressable points; y and y+p encode the same pubkey
         cases = []
@@ -136,7 +157,7 @@ class TestVerifyBatch:
         n = len(msgs)
         pubs = np.frombuffer(b"".join(pubs_l), np.uint8).reshape(n, 32).copy()
         sigs = np.frombuffer(b"".join(sigs_l), np.uint8).reshape(n, 64).copy()
-        got = kernel.verify_batch(pubs, msgs, sigs)
+        got = _verify(backend, pubs, msgs, sigs)
         want = _oracle(pubs, msgs, sigs)
         # NOTE: y and y+p decompress to the same point but hash differently
         # (pubkey *bytes* enter h = SHA512(R||A||M)), so twins may legitimately
@@ -145,39 +166,39 @@ class TestVerifyBatch:
         # low-order pubkey where [h](-A) happens to encode to zeros.)
         assert got.tolist() == want.tolist()
 
-    def test_invalid_pubkey_decompression(self):
+    def test_invalid_pubkey_decompression(self, backend):
         pubs, msgs, sigs = _mk(3)
         for y in range(2, 200):
             if ed._decompress_xy(y.to_bytes(32, "little")) is None:
                 pubs[1] = np.frombuffer(y.to_bytes(32, "little"), np.uint8)
                 break
-        got = kernel.verify_batch(pubs, msgs, sigs)
+        got = _verify(backend, pubs, msgs, sigs)
         assert got.tolist() == [True, False, True]
 
-    def test_zero_scalar_identity_edge(self):
+    def test_zero_scalar_identity_edge(self, backend):
         """s=0, h arbitrary, R=identity-encoding: match oracle exactly."""
         pubs, msgs, sigs = _mk(1)
         ident_enc = (1).to_bytes(32, "little")  # y=1, x=0 == identity point
         sigs[0, :32] = np.frombuffer(ident_enc, np.uint8)
         sigs[0, 32:] = 0
-        got = kernel.verify_batch(pubs, msgs, sigs)
+        got = _verify(backend, pubs, msgs, sigs)
         assert got.tolist() == _oracle(pubs, msgs, sigs).tolist()
 
-    def test_mixed_large_batch_matches_oracle(self):
+    def test_mixed_large_batch_matches_oracle(self, backend):
         rng = np.random.default_rng(3)
         pubs, msgs, sigs = _mk(40, msg_len=70)
         # corrupt a random third
         for i in rng.choice(40, 13, replace=False):
             sigs[i, rng.integers(0, 64)] ^= 1 + rng.integers(0, 254)
-        got = kernel.verify_batch(pubs, msgs, sigs)
+        got = _verify(backend, pubs, msgs, sigs)
         assert got.tolist() == _oracle(pubs, msgs, sigs).tolist()
 
-    def test_empty(self):
-        assert kernel.verify_batch(
-            np.zeros((0, 32), np.uint8), [], np.zeros((0, 64), np.uint8)
+    def test_empty(self, backend):
+        assert _verify(
+            backend, np.zeros((0, 32), np.uint8), [], np.zeros((0, 64), np.uint8)
         ).shape == (0,)
 
-    def test_variable_length_messages(self):
+    def test_variable_length_messages(self, backend):
         pubs, msgs, sigs = [], [], []
         for i, ln in enumerate([0, 1, 17, 1000]):
             priv = ed.gen_privkey(bytes([40 + i]) * 32)
@@ -188,7 +209,7 @@ class TestVerifyBatch:
             sigs.append(ed.sign(priv, m))
         pubs = np.frombuffer(b"".join(pubs), np.uint8).reshape(4, 32).copy()
         sigs = np.frombuffer(b"".join(sigs), np.uint8).reshape(4, 64).copy()
-        assert kernel.verify_batch(pubs, msgs, sigs).all()
+        assert _verify(backend, pubs, msgs, sigs).all()
 
 
 class TestSharded:
@@ -221,3 +242,31 @@ class TestBatchVerifierBoundary:
         host = HostBatchVerifier().verify_ed25519(items)
         tpu = TPUBatchVerifier().verify_ed25519(items)
         assert host.tolist() == tpu.tolist()
+
+    def test_default_backend_is_pallas_on_tpu(self):
+        from tendermint_tpu.crypto.batch import TPUBatchVerifier
+
+        v = TPUBatchVerifier()
+        if _TPU is not None:
+            assert v.backend == "pallas"
+        else:
+            assert v.backend == "xla"
+
+    @pytest.mark.skipif(_TPU is None, reason="needs the real chip")
+    def test_pallas_backend_parity(self):
+        from tendermint_tpu.crypto.batch import (
+            HostBatchVerifier,
+            SigItem,
+            TPUBatchVerifier,
+        )
+
+        pubs, msgs, sigs = _mk(12)
+        sigs[1, 40] ^= 2
+        sigs[7, 0] ^= 1
+        items = [
+            SigItem(pubs[i].tobytes(), msgs[i], sigs[i].tobytes())
+            for i in range(12)
+        ]
+        host = HostBatchVerifier().verify_ed25519(items)
+        pal = TPUBatchVerifier(backend="pallas").verify_ed25519(items)
+        assert host.tolist() == pal.tolist()
