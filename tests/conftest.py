@@ -29,3 +29,11 @@ except Exception:
     jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# Consensus/state tests verify tiny commits in their hot loops; the process-wide
+# default verifier must NOT auto-select the tunnel-attached TPU (per-dispatch
+# latency ~1s would blow the tests' liveness timeouts). Pallas/XLA tests build
+# their own verifiers explicitly.
+from tendermint_tpu.crypto import batch as _batch  # noqa: E402
+
+_batch.set_batch_verifier(_batch.HostBatchVerifier())
