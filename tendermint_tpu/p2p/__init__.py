@@ -1,0 +1,31 @@
+"""P2P stack: authenticated encrypted transport, multiplexed prioritized
+channels, switch + reactor registry, peer exchange (ref: /root/reference/p2p/).
+"""
+
+from tendermint_tpu.p2p.base_reactor import Reactor
+from tendermint_tpu.p2p.conn.connection import ChannelDescriptor, MConnConfig, MConnection
+from tendermint_tpu.p2p.conn.secret_connection import SecretConnection
+from tendermint_tpu.p2p.key import NodeKey
+from tendermint_tpu.p2p.netaddress import NetAddress
+from tendermint_tpu.p2p.node_info import NodeInfo, ProtocolVersion
+from tendermint_tpu.p2p.peer import Peer, PeerSet
+from tendermint_tpu.p2p.switch import Switch, SwitchConfig
+from tendermint_tpu.p2p.transport import MultiplexTransport, UpgradedConn
+
+__all__ = [
+    "ChannelDescriptor",
+    "MConnConfig",
+    "MConnection",
+    "MultiplexTransport",
+    "NetAddress",
+    "NodeInfo",
+    "NodeKey",
+    "Peer",
+    "PeerSet",
+    "ProtocolVersion",
+    "Reactor",
+    "SecretConnection",
+    "Switch",
+    "SwitchConfig",
+    "UpgradedConn",
+]

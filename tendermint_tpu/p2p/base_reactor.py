@@ -1,0 +1,42 @@
+"""Reactor interface (ref: p2p/base_reactor.go).
+
+A reactor owns a set of channels on every peer and reacts to messages on
+them. The Switch calls the lifecycle hooks; reactors call ``peer.send`` /
+``switch.broadcast`` to talk back.
+"""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING, List, Optional
+
+from tendermint_tpu.libs.service import BaseService
+from tendermint_tpu.p2p.conn.connection import ChannelDescriptor
+
+if TYPE_CHECKING:
+    from tendermint_tpu.p2p.peer import Peer
+    from tendermint_tpu.p2p.switch import Switch
+
+
+class Reactor(BaseService):
+    def __init__(self, name: str = "Reactor"):
+        super().__init__(name=name)
+        self.switch: Optional["Switch"] = None
+
+    def set_switch(self, sw: "Switch") -> None:
+        self.switch = sw
+
+    def get_channels(self) -> List[ChannelDescriptor]:
+        """Static channel descriptors this reactor serves."""
+        raise NotImplementedError
+
+    def add_peer(self, peer: "Peer") -> None:
+        """Called by the Switch after the peer is started and registered."""
+
+    def remove_peer(self, peer: "Peer", reason: object) -> None:
+        """Called by the Switch when the peer is stopped (error or graceful).
+        May arrive for a peer this reactor never saw add_peer for (a peer can
+        error out during admission) — implementations must tolerate that."""
+
+    def receive(self, chan_id: int, peer: "Peer", msg_bytes: bytes) -> None:
+        """A complete message arrived on one of this reactor's channels.
+        Runs on the peer's recv thread — don't block for long."""

@@ -1,0 +1,480 @@
+"""P2P stack tests: SecretConnection, MConnection, NodeInfo, Switch +
+reactors (ref test models: p2p/conn/secret_connection_test.go,
+p2p/conn/connection_test.go, p2p/switch_test.go, p2p/transport_test.go).
+"""
+
+import socket
+import threading
+import time
+
+import pytest
+
+from tendermint_tpu.crypto.keys import PrivKeyEd25519
+from tendermint_tpu.p2p import (
+    ChannelDescriptor,
+    MConnConfig,
+    MConnection,
+    MultiplexTransport,
+    NetAddress,
+    NodeInfo,
+    NodeKey,
+    ProtocolVersion,
+    Reactor,
+    Switch,
+    SwitchConfig,
+)
+from tendermint_tpu.p2p.conn.secret_connection import RawConn, SecretConnection
+from tendermint_tpu.p2p.errors import RejectedError
+from tendermint_tpu.p2p.test_util import (
+    connect_switches,
+    make_connected_switches,
+    make_switch,
+    stop_switches,
+)
+
+
+def _wait_until(pred, timeout=5.0, step=0.01):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(step)
+    return pred()
+
+
+# ---------------------------------------------------------------------------
+# NetAddress
+# ---------------------------------------------------------------------------
+
+
+class TestNetAddress:
+    def test_parse_roundtrip(self):
+        s = "aa" * 20 + "@1.2.3.4:26656"
+        addr = NetAddress.parse(s)
+        assert addr.id == "aa" * 20
+        assert addr.host == "1.2.3.4"
+        assert addr.port == 26656
+        assert str(addr) == s
+
+    def test_parse_requires_id(self):
+        with pytest.raises(ValueError):
+            NetAddress.parse("1.2.3.4:26656")
+
+    def test_bad_id(self):
+        with pytest.raises(ValueError):
+            NetAddress.parse("zz" * 20 + "@1.2.3.4:26656")
+
+    def test_routable(self):
+        mk = lambda host: NetAddress("", host, 26656)
+        assert mk("8.8.8.8").routable()
+        assert not mk("127.0.0.1").routable()
+        assert not mk("10.0.0.1").routable()
+        assert not mk("192.168.1.1").routable()
+
+
+# ---------------------------------------------------------------------------
+# NodeInfo
+# ---------------------------------------------------------------------------
+
+
+def _node_info(node_key=None, network="net", channels=b"\x20\x21", block=8):
+    nk = node_key or NodeKey(PrivKeyEd25519.generate())
+    return NodeInfo(
+        protocol_version=ProtocolVersion(block=block),
+        id=nk.id(),
+        listen_addr="127.0.0.1:26656",
+        network=network,
+        version="0.1.0",
+        channels=channels,
+        moniker="n",
+    )
+
+
+class TestNodeInfo:
+    def test_validate_ok(self):
+        _node_info().validate()
+
+    def test_validate_rejects_dup_channels(self):
+        with pytest.raises(ValueError):
+            _node_info(channels=b"\x20\x20").validate()
+
+    def test_validate_rejects_bad_id(self):
+        ni = _node_info()
+        object.__setattr__(ni, "id", "nothex")
+        with pytest.raises(ValueError):
+            ni.validate()
+
+    def test_compatible(self):
+        a, b = _node_info(), _node_info()
+        a.compatible_with(b)
+        with pytest.raises(ValueError):
+            a.compatible_with(_node_info(network="other"))
+        with pytest.raises(ValueError):
+            a.compatible_with(_node_info(block=9))
+        with pytest.raises(ValueError):
+            a.compatible_with(_node_info(channels=b"\x99"))
+
+    def test_wire_roundtrip(self):
+        ni = _node_info()
+        assert NodeInfo.from_bytes(ni.to_bytes()) == ni
+
+
+# ---------------------------------------------------------------------------
+# SecretConnection
+# ---------------------------------------------------------------------------
+
+
+def _make_secret_pair():
+    s1, s2 = socket.socketpair()
+    k1, k2 = PrivKeyEd25519.generate(), PrivKeyEd25519.generate()
+    out = [None, None]
+    err = [None, None]
+
+    def go(i, sock, key):
+        try:
+            out[i] = SecretConnection(RawConn(sock), key)
+        except Exception as e:
+            err[i] = e
+
+    t1 = threading.Thread(target=go, args=(0, s1, k1))
+    t2 = threading.Thread(target=go, args=(1, s2, k2))
+    t1.start(), t2.start()
+    t1.join(5), t2.join(5)
+    assert err == [None, None], err
+    return out[0], out[1], k1, k2
+
+
+class TestSecretConnection:
+    def test_handshake_authenticates_identities(self):
+        c1, c2, k1, k2 = _make_secret_pair()
+        assert c1.remote_pubkey.bytes() == k2.pub_key().bytes()
+        assert c2.remote_pubkey.bytes() == k1.pub_key().bytes()
+        c1.close()
+
+    def test_data_roundtrip_both_directions(self):
+        c1, c2, _, _ = _make_secret_pair()
+        c1.write(b"hello from 1")
+        assert c2.read_exactly(12) == b"hello from 1"
+        c2.write(b"hi")
+        assert c1.read_exactly(2) == b"hi"
+        c1.close()
+
+    def test_large_message_spans_frames(self):
+        c1, c2, _, _ = _make_secret_pair()
+        blob = bytes(range(256)) * 40  # 10240 B > 1024-byte frame
+        c1.write(blob)
+        assert c2.read_exactly(len(blob)) == blob
+        c1.close()
+
+    def test_ciphertext_on_the_wire(self):
+        # plaintext must not appear on the raw socket
+        s1, s2 = socket.socketpair()
+        k1, k2 = PrivKeyEd25519.generate(), PrivKeyEd25519.generate()
+        captured = []
+
+        class SniffRaw(RawConn):
+            def write(self, data):
+                captured.append(bytes(data))
+                super().write(data)
+
+        out = [None, None]
+
+        def go(i, sock, key, cls):
+            out[i] = SecretConnection(cls(sock), key)
+
+        t1 = threading.Thread(target=go, args=(0, s1, k1, SniffRaw))
+        t2 = threading.Thread(target=go, args=(1, s2, k2, RawConn))
+        t1.start(), t2.start()
+        t1.join(5), t2.join(5)
+        secret = b"attack at dawn (this must never appear in the clear)"
+        out[0].write(secret)
+        assert out[1].read_exactly(len(secret)) == secret
+        assert all(secret not in frame for frame in captured)
+        out[0].close()
+
+    def test_tampered_frame_rejected(self):
+        c1, c2, _, _ = _make_secret_pair()
+        # inject a bit flip on the raw socket between the two ends
+        raw = c1._conn
+        sealed_garbage = bytearray(1044)
+        raw.write(bytes(sealed_garbage))
+        with pytest.raises(ConnectionError):
+            c2.read_exactly(1)
+        c1.close()
+
+
+# ---------------------------------------------------------------------------
+# MConnection
+# ---------------------------------------------------------------------------
+
+
+def _mconn_pair(descs, on_recv1, on_recv2, cfg=None):
+    cfg = cfg or MConnConfig.test_config()
+    s1, s2 = socket.socketpair()
+    errs = []
+    m1 = MConnection(RawConn(s1), descs, on_recv1, errs.append, cfg, name="m1")
+    m2 = MConnection(RawConn(s2), descs, on_recv2, errs.append, cfg, name="m2")
+    m1.start(), m2.start()
+    return m1, m2, errs
+
+
+class TestMConnection:
+    DESCS = [
+        ChannelDescriptor(id=0x01, priority=1, send_queue_capacity=32),
+        ChannelDescriptor(id=0x02, priority=10, send_queue_capacity=32),
+    ]
+
+    def test_send_receive_multichannel(self):
+        got = {0x01: [], 0x02: []}
+        done = threading.Event()
+
+        def recv(cid, msg):
+            got[cid].append(msg)
+            if len(got[0x01]) == 1 and len(got[0x02]) == 1:
+                done.set()
+
+        m1, m2, errs = _mconn_pair(self.DESCS, lambda c, m: None, recv)
+        try:
+            assert m1.send(0x01, b"alpha")
+            assert m1.send(0x02, b"beta")
+            assert done.wait(5)
+            assert got[0x01] == [b"alpha"]
+            assert got[0x02] == [b"beta"]
+            assert not errs
+        finally:
+            m1.stop(), m2.stop()
+
+    def test_large_message_packetized(self):
+        blob = b"\xab" * 50_000  # ~49 packets
+        got = []
+        done = threading.Event()
+
+        def recv(cid, msg):
+            got.append((cid, msg))
+            done.set()
+
+        m1, m2, errs = _mconn_pair(self.DESCS, lambda c, m: None, recv)
+        try:
+            assert m1.send(0x02, blob)
+            assert done.wait(10)
+            assert got == [(0x02, blob)]
+        finally:
+            m1.stop(), m2.stop()
+
+    def test_send_unknown_channel_fails(self):
+        m1, m2, _ = _mconn_pair(self.DESCS, lambda c, m: None, lambda c, m: None)
+        try:
+            assert not m1.send(0x77, b"x")
+        finally:
+            m1.stop(), m2.stop()
+
+    def test_peer_disconnect_fires_on_error(self):
+        errs1 = []
+        s1, s2 = socket.socketpair()
+        m1 = MConnection(
+            RawConn(s1),
+            self.DESCS,
+            lambda c, m: None,
+            errs1.append,
+            MConnConfig.test_config(),
+        )
+        m1.start()
+        s2.close()
+        m1.send(0x01, b"ping into the void")
+        assert _wait_until(lambda: len(errs1) == 1)
+        assert not m1.is_running
+
+    def test_pong_timeout_errors_out(self):
+        # peer that never answers pings: raw socket with no MConnection
+        s1, s2 = socket.socketpair()
+        errs = []
+        cfg = MConnConfig.test_config()
+        m1 = MConnection(
+            RawConn(s1), self.DESCS, lambda c, m: None, errs.append, cfg
+        )
+        m1.start()
+        try:
+            assert _wait_until(
+                lambda: errs and "pong" in str(errs[0]),
+                timeout=cfg.ping_interval + cfg.pong_timeout + 2,
+            )
+        finally:
+            s2.close()
+
+
+# ---------------------------------------------------------------------------
+# Switch + reactors
+# ---------------------------------------------------------------------------
+
+
+class EchoReactor(Reactor):
+    """Echoes every message back on the same channel; records receipts."""
+
+    def __init__(self, chan_id=0x10, echo=True):
+        super().__init__(name=f"Echo-{chan_id:#x}")
+        self.chan_id = chan_id
+        self.echo = echo
+        self.received = []
+        self.peers_added = []
+        self.peers_removed = []
+
+    def get_channels(self):
+        return [ChannelDescriptor(id=self.chan_id, priority=5, send_queue_capacity=32)]
+
+    def add_peer(self, peer):
+        self.peers_added.append(peer.id)
+
+    def remove_peer(self, peer, reason):
+        self.peers_removed.append(peer.id)
+
+    def receive(self, chan_id, peer, msg_bytes):
+        self.received.append((peer.id, msg_bytes))
+        if self.echo and not msg_bytes.startswith(b"echo:"):
+            peer.send(chan_id, b"echo:" + msg_bytes)
+
+
+class TestSwitch:
+    def test_two_switches_exchange_messages(self):
+        reactors = {}
+
+        def init(i, sw):
+            reactors[i] = sw.add_reactor("echo", EchoReactor())
+            return sw
+
+        sws = make_connected_switches(2, init)
+        try:
+            assert sws[0].peers.size() == 1
+            assert sws[1].peers.size() == 1
+            peer = sws[0].peers.list()[0]
+            assert peer.send(0x10, b"marco")
+            assert _wait_until(lambda: reactors[0].received)
+            assert reactors[0].received[0][1] == b"echo:marco"
+        finally:
+            stop_switches(sws)
+
+    def test_reactor_peer_lifecycle_hooks(self):
+        reactors = {}
+
+        def init(i, sw):
+            reactors[i] = sw.add_reactor("echo", EchoReactor(echo=False))
+            return sw
+
+        sws = make_connected_switches(3, init)
+        try:
+            assert _wait_until(lambda: len(reactors[0].peers_added) == 2)
+            victim = sws[0].peers.list()[0]
+            sws[0].stop_peer_for_error(victim, "test")
+            assert _wait_until(lambda: reactors[0].peers_removed == [victim.id])
+            assert sws[0].peers.size() == 1
+        finally:
+            stop_switches(sws)
+
+    def test_broadcast_reaches_all_peers(self):
+        reactors = {}
+
+        def init(i, sw):
+            reactors[i] = sw.add_reactor("echo", EchoReactor(echo=False))
+            return sw
+
+        sws = make_connected_switches(4, init)
+        try:
+            sws[0].broadcast(0x10, b"to-everyone")
+            for i in (1, 2, 3):
+                assert _wait_until(lambda i=i: reactors[i].received), i
+                assert reactors[i].received[0][1] == b"to-everyone"
+            assert not reactors[0].received
+        finally:
+            stop_switches(sws)
+
+    def test_duplicate_channel_id_rejected(self):
+        sw = make_switch(init_switch=lambda i, s: s.add_reactor("a", EchoReactor()) and s)
+        with pytest.raises(ValueError):
+            sw.add_reactor("b", EchoReactor())
+
+    def test_peer_error_removes_peer(self):
+        reactors = {}
+
+        def init(i, sw):
+            reactors[i] = sw.add_reactor("echo", EchoReactor(echo=False))
+            return sw
+
+        sws = make_connected_switches(2, init)
+        try:
+            # kill the underlying conn of sw0's peer: sw1 should drop it too
+            peer0 = sws[0].peers.list()[0]
+            peer0.mconn._conn.close()
+            assert _wait_until(lambda: sws[0].peers.size() == 0)
+            assert _wait_until(lambda: sws[1].peers.size() == 0)
+        finally:
+            stop_switches(sws)
+
+
+# ---------------------------------------------------------------------------
+# Real TCP transport (listener + dialer, full upgrade path)
+# ---------------------------------------------------------------------------
+
+
+class TestTransportTCP:
+    def _make(self, network="tcp-net"):
+        def init(i, sw):
+            sw.add_reactor("echo", EchoReactor())
+            return sw
+
+        return make_switch(init_switch=init, network=network)
+
+    def test_dial_accept_full_upgrade(self):
+        sw1, sw2 = self._make(), self._make()
+        sw1.start(), sw2.start()
+        try:
+            laddr = sw1.transport.listen("127.0.0.1:0")
+            peer = sw2.dial_peer_with_address(laddr)
+            assert peer.id == sw1.node_id
+            assert _wait_until(lambda: sw1.peers.size() == 1)
+            # data flows end-to-end over TCP + SecretConnection
+            r2 = sw2.reactors["echo"]
+            assert peer.send(0x10, b"over-tcp")
+            assert _wait_until(lambda: r2.received)
+            assert r2.received[0][1] == b"echo:over-tcp"
+        finally:
+            stop_switches([sw1, sw2])
+
+    def test_dial_wrong_id_rejected(self):
+        sw1, sw2 = self._make(), self._make()
+        sw1.start(), sw2.start()
+        try:
+            laddr = sw1.transport.listen("127.0.0.1:0")
+            wrong = NetAddress("ab" * 20, laddr.host, laddr.port)
+            with pytest.raises(RejectedError) as ei:
+                sw2.dial_peer_with_address(wrong)
+            assert ei.value.is_auth_failure
+            assert sw2.peers.size() == 0
+        finally:
+            stop_switches([sw1, sw2])
+
+    def test_network_mismatch_rejected(self):
+        sw1 = self._make("net-A")
+        sw2 = self._make("net-B")
+        sw1.start(), sw2.start()
+        try:
+            laddr = sw1.transport.listen("127.0.0.1:0")
+            with pytest.raises(RejectedError) as ei:
+                sw2.dial_peer_with_address(laddr)
+            assert ei.value.is_incompatible
+        finally:
+            stop_switches([sw1, sw2])
+
+    def test_persistent_peer_reconnects(self):
+        sw1, sw2 = self._make(), self._make()
+        sw1.start(), sw2.start()
+        try:
+            laddr = sw1.transport.listen("127.0.0.1:0")
+            peer = sw2.dial_peer_with_address(laddr, persistent=True)
+            assert _wait_until(lambda: sw1.peers.size() == 1)
+            # sever the connection from sw1's side
+            sws1_peer = sw1.peers.list()[0]
+            sw1.stop_peer_for_error(sws1_peer, "simulated failure")
+            # sw2 notices + redials automatically (persistent)
+            assert _wait_until(lambda: sw2.peers.size() == 1 and sw2.peers.list()[0].is_running, timeout=10)
+            assert _wait_until(lambda: sw1.peers.size() == 1, timeout=10)
+        finally:
+            stop_switches([sw1, sw2])
