@@ -222,6 +222,32 @@ class MsgInfo:
         return cls(decode_msg(r), peer_id)
 
 
+@dataclass
+class NewValidBlockMessage:
+    """Block-parts availability for the polka'd block (reactor.go:1444
+    NewValidBlockMessage): lets peers fetch a valid/committed block's parts
+    even after the round moved on."""
+
+    height: int
+    round: int
+    block_parts_header: PartSetHeader
+    block_parts: BitArray
+    is_commit: bool
+
+    def encode(self, w: Writer) -> None:
+        w.svarint(self.height).svarint(self.round)
+        self.block_parts_header.encode(w)
+        self.block_parts.encode(w)
+        w.bool(self.is_commit)
+
+    @classmethod
+    def decode(cls, r: Reader) -> "NewValidBlockMessage":
+        return cls(
+            r.svarint(), r.svarint(), PartSetHeader.decode(r), BitArray.decode(r),
+            r.bool(),
+        )
+
+
 _REGISTRY = [
     NewRoundStepMessage,
     CommitStepMessage,
@@ -236,6 +262,7 @@ _REGISTRY = [
     EndHeightMessage,
     EventRoundStep,
     MsgInfo,
+    NewValidBlockMessage,  # appended: registry tags are append-only (WAL compat)
 ]
 _TAG = {cls: i + 1 for i, cls in enumerate(_REGISTRY)}
 

@@ -113,6 +113,7 @@ class ConsensusState(BaseService):
         self.evsw = EventSwitch()
         self.n_steps = 0
         self.replay_mode = False
+        self.skip_wal_catchup = False  # set after fast sync (reactor.go:116)
         self._done = threading.Event()
 
         # test hooks (state.go:113-115, byzantine_test)
@@ -158,7 +159,7 @@ class ConsensusState(BaseService):
         # WAL catchup replay happens BEFORE processing new messages
         from tendermint_tpu.consensus.replay import catchup_replay
 
-        if not isinstance(self.wal, NilWAL):
+        if not isinstance(self.wal, NilWAL) and not self.skip_wal_catchup:
             catchup_replay(self, self.rs.height)
         self.timeout_ticker.start()
         threading.Thread(target=self._ticker_forwarder, daemon=True).start()

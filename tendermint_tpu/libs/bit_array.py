@@ -97,9 +97,16 @@ class BitArray:
         self.encode(w)
         return w.build()
 
+    # wire-decode bound: bitmaps index validators or block parts, both far
+    # below 16M; an unbounded peer-supplied `bits` would let one message
+    # materialize a giant int (memory-exhaustion DoS)
+    MAX_DECODE_BITS = 1 << 24
+
     @classmethod
     def decode(cls, r: Reader) -> "BitArray":
         bits = r.uvarint()
+        if bits > cls.MAX_DECODE_BITS:
+            raise ValueError(f"BitArray bits {bits} exceeds decode bound")
         return cls(bits, int.from_bytes(r.bytes(), "little"))
 
     @classmethod

@@ -26,6 +26,7 @@ from tendermint_tpu.libs.service import BaseService
 from tendermint_tpu.p2p.base_reactor import Reactor
 from tendermint_tpu.p2p.conn.connection import ChannelDescriptor, MConnConfig
 from tendermint_tpu.p2p.errors import (
+    P2PError,
     SwitchConnectToSelfError,
     SwitchDuplicatePeerIDError,
     SwitchDuplicatePeerIPError,
@@ -104,6 +105,16 @@ class Switch(BaseService):
         ).start()
 
     def on_stop(self) -> None:
+        # transport first: no new upgrades may complete and land in
+        # _accept_routine once peers/reactors are going down
+        if self.transport.is_running:
+            try:
+                self.transport.stop()
+            except Exception:
+                self.logger.exception("stopping transport")
+        else:
+            # never listened: still unblock our accept routine
+            self.transport._push_closed_sentinel()
         for peer in self.peers.list():
             self._stop_and_remove_peer(peer, reason="switch stopping")
         for reactor in reversed(list(self.reactors.values())):
@@ -141,6 +152,12 @@ class Switch(BaseService):
             raise SwitchConnectToSelfError(addr)
         if self.peers.has(addr.id):
             raise SwitchDuplicatePeerIDError(addr.id)
+        if not persistent:
+            outbound = sum(1 for p in self.peers.list() if p.outbound)
+            if outbound >= self.config.max_num_outbound_peers:
+                raise P2PError(
+                    f"outbound peer cap reached ({outbound})"
+                )
         with self._mtx:
             if addr.id in self._dialing:
                 raise SwitchDuplicatePeerIDError(addr.id)

@@ -78,11 +78,14 @@ class EvidencePool:
 
 
 class MockEvidencePool(EvidencePool):
+    def __init__(self):
+        self.added: list = []  # recorded for test assertions, never proposed
+
     def pending_evidence(self, max_bytes: int) -> list:
         return []
 
     def add_evidence(self, ev) -> None:
-        pass
+        self.added.append(ev)
 
     def update(self, block, state) -> None:
         pass

@@ -76,19 +76,19 @@ def make_genesis(n_vals: int, power: int = 10):
     return doc, pvs
 
 
-def make_consensus_state(
-    n_vals: int,
-    our_index: int = 0,
+def make_cs_from_genesis(
+    doc: GenesisDoc,
+    pv=None,
     config=None,
     wal=None,
     state_db=None,
     block_store_db=None,
     app=None,
-) -> Tuple[ConsensusState, List[ValidatorStub], EventBus]:
-    """Our ConsensusState at validator `our_index` + stubs for the rest,
-    indexed by position in the sorted validator set."""
+) -> Tuple[ConsensusState, EventBus]:
+    """One full ConsensusState (own stores, own app) for a shared genesis —
+    the per-node builder the multi-node net is assembled from
+    (common_test.go newConsensusStateWithConfigAndBlockStore)."""
     cfg = config or test_config()
-    doc, pvs = make_genesis(n_vals)
     st = state_from_genesis(doc)
     state_db = state_db if state_db is not None else MemDB()
     sm_store.save_state(state_db, st)
@@ -107,17 +107,91 @@ def make_consensus_state(
         cfg.consensus, st.copy(), block_exec, block_store, mempool, evpool, wal=wal
     )
     cs.set_event_bus(bus)
+    if pv is not None:
+        cs.set_priv_validator(pv)
+    return cs, bus
 
-    # order stubs by sorted-set index
+
+def make_consensus_state(
+    n_vals: int,
+    our_index: int = 0,
+    config=None,
+    wal=None,
+    state_db=None,
+    block_store_db=None,
+    app=None,
+) -> Tuple[ConsensusState, List[ValidatorStub], EventBus]:
+    """Our ConsensusState at validator `our_index` + stubs for the rest,
+    indexed by position in the sorted validator set."""
+    doc, pvs = make_genesis(n_vals)
+    st = state_from_genesis(doc)
     by_addr = {pv.get_pub_key().address(): pv for pv in pvs}
     sorted_pvs = [by_addr[v.address] for v in st.validators.validators]
-    cs.set_priv_validator(sorted_pvs[our_index])
+    cs, bus = make_cs_from_genesis(
+        doc, sorted_pvs[our_index], config=config, wal=wal,
+        state_db=state_db, block_store_db=block_store_db, app=app,
+    )
     stubs = [
         ValidatorStub(pv, i)
         for i, pv in enumerate(sorted_pvs)
         if i != our_index
     ]
     return cs, stubs, bus
+
+
+class NetNode:
+    """One node of an in-proc consensus net."""
+
+    def __init__(self, cs, bus, reactor, pv):
+        self.cs = cs
+        self.bus = bus
+        self.reactor = reactor
+        self.pv = pv
+        self.switch = None
+
+
+def make_consensus_net(
+    n_vals: int,
+    config=None,
+    app_factory=None,
+    mconfig=None,
+) -> List[NetNode]:
+    """N real ConsensusStates gossiping over in-proc connected switches —
+    the reference's randConsensusNet + MakeConnectedSwitches tier
+    (common_test.go:527, p2p/test_util.go:68). Returns started nodes."""
+    from tendermint_tpu.consensus.reactor import ConsensusReactor
+    from tendermint_tpu.p2p.test_util import make_connected_switches
+
+    cfg = config or test_config()
+    doc, pvs = make_genesis(n_vals)
+    st = state_from_genesis(doc)
+    by_addr = {pv.get_pub_key().address(): pv for pv in pvs}
+    sorted_pvs = [by_addr[v.address] for v in st.validators.validators]
+
+    nodes: List[NetNode] = []
+    for i in range(n_vals):
+        app = app_factory(i) if app_factory is not None else KVStoreApp()
+        cs, bus = make_cs_from_genesis(doc, sorted_pvs[i], config=cfg, app=app)
+        reactor = ConsensusReactor(cs)
+        nodes.append(NetNode(cs, bus, reactor, sorted_pvs[i]))
+
+    switches = make_connected_switches(
+        n_vals,
+        lambda i, sw: sw.add_reactor("consensus", nodes[i].reactor) and sw,
+        network=CHAIN_ID,
+        mconfig=mconfig,
+    )
+    for node, sw in zip(nodes, switches):
+        node.switch = sw
+    return nodes
+
+
+def stop_consensus_net(nodes: List[NetNode]) -> None:
+    for node in nodes:
+        if node.switch is not None and node.switch.is_running:
+            node.switch.stop()  # stops the reactor, which stops the cs
+        if node.bus.is_running:
+            node.bus.stop()
 
 
 def wait_for(predicate, timeout: float = 10.0, interval: float = 0.01) -> bool:

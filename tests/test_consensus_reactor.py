@@ -1,0 +1,136 @@
+"""Multi-node consensus-over-p2p tests — the reference's tier-1 substrate:
+N real ConsensusStates gossiping through real (in-proc) switches
+(ref: consensus/reactor_test.go:87 TestReactorBasic, :272 voting power change,
+byzantine_test.go:29).
+"""
+
+import base64
+import time
+
+import pytest
+
+from tendermint_tpu.abci.examples.kvstore import PersistentKVStoreApp
+from tendermint_tpu.consensus.messages import VoteMessage, encode_msg
+from tendermint_tpu.consensus.reactor import VOTE_CHANNEL
+from tendermint_tpu.types import BlockID, PartSetHeader, SignedMsgType, Vote
+from tendermint_tpu.types.events import EVENT_NEW_BLOCK, query_for_event
+
+from tests.consensus_harness import (
+    make_consensus_net,
+    stop_consensus_net,
+    wait_for,
+)
+
+def _wait_all_heights(nodes, height, timeout=60.0):
+    """Every node's consensus state reaches at least `height`."""
+    return wait_for(
+        lambda: all(n.cs.get_round_state().height >= height for n in nodes),
+        timeout=timeout,
+        interval=0.05,
+    )
+
+
+class TestReactorBasic:
+    def test_4_node_net_commits_blocks(self):
+        nodes = make_consensus_net(4)
+        try:
+            assert _wait_all_heights(nodes, 4), [
+                n.cs.get_round_state().height for n in nodes
+            ]
+            # all nodes committed identical blocks
+            h2_hashes = {
+                n.cs.block_store.load_block(2).hash() for n in nodes
+            }
+            assert len(h2_hashes) == 1
+            h3_metas = [n.cs.block_store.load_block_meta(3) for n in nodes]
+            assert all(m is not None for m in h3_metas)
+            assert len({m.header.app_hash for m in h3_metas}) == 1
+        finally:
+            stop_consensus_net(nodes)
+
+    def test_net_emits_new_block_events(self):
+        nodes = make_consensus_net(4)
+        subs = [
+            n.bus.subscribe(f"test-{i}", query_for_event(EVENT_NEW_BLOCK))
+            for i, n in enumerate(nodes)
+        ]
+        try:
+            for sub in subs:
+                msg = sub.get(timeout=60)
+                assert msg.data.block.height >= 1
+        finally:
+            stop_consensus_net(nodes)
+
+
+class TestReactorValidatorSetChanges:
+    def test_voting_power_change_mid_run(self):
+        nodes = make_consensus_net(4, app_factory=lambda i: PersistentKVStoreApp())
+        try:
+            assert _wait_all_heights(nodes, 2)
+            # bump node 1's validator power 10 -> 25 via the app's val tx
+            target_pub = nodes[1].pv.get_pub_key().bytes()
+            tx = b"val:" + base64.b64encode(target_pub) + b"!25"
+            nodes[0].cs.mempool.check_tx(tx)
+
+            def power_updated():
+                for n in nodes:
+                    st = n.cs.get_state()
+                    _, val = st.validators.get_by_address(
+                        nodes[1].pv.get_pub_key().address()
+                    )
+                    if val is None or val.voting_power != 25:
+                        return False
+                return True
+
+            assert wait_for(power_updated, timeout=60.0, interval=0.05)
+            # net keeps committing after the valset change
+            h = max(n.cs.get_round_state().height for n in nodes)
+            assert _wait_all_heights(nodes, h + 2)
+        finally:
+            stop_consensus_net(nodes)
+
+
+class TestByzantine:
+    def test_double_signed_votes_become_evidence_and_net_lives(self):
+        nodes = make_consensus_net(4)
+        try:
+            assert _wait_all_heights(nodes, 2)
+            byz, honest = nodes[0], nodes[1]
+            # byzantine: sign two conflicting prevotes for the same H/R and
+            # deliver both to one honest peer's reactor (byzantine_test.go:29
+            # sends conflicting msgs to different peers; same-peer delivery
+            # guarantees the conflict is observed -> DuplicateVoteEvidence)
+            rs = byz.cs.get_round_state()
+            height, round = rs.height, rs.round
+            idx, _ = rs.validators.get_by_address(byz.pv.get_pub_key().address())
+            votes = []
+            for h in (b"\xaa" * 32, b"\xbb" * 32):
+                vote = Vote(
+                    vote_type=SignedMsgType.PREVOTE,
+                    height=height,
+                    round=round,
+                    timestamp_ns=time.time_ns(),
+                    block_id=BlockID(hash=h, parts_header=PartSetHeader(1, b"\xcc" * 32)),
+                    validator_address=byz.pv.get_pub_key().address(),
+                    validator_index=idx,
+                )
+                votes.append(byz.pv.sign_vote(byz.cs.state.chain_id, vote))
+            # push both votes to the honest node as if gossiped by byz
+            byz_peer_on_honest = honest.switch.peers.get(byz.switch.node_id)
+            assert byz_peer_on_honest is not None
+            for v in votes:
+                honest.reactor.receive(
+                    VOTE_CHANNEL, byz_peer_on_honest, encode_msg(VoteMessage(v))
+                )
+
+            assert wait_for(
+                lambda: len(honest.cs.evpool.added) > 0, timeout=30.0
+            ), "honest node never recorded DuplicateVoteEvidence"
+            ev = honest.cs.evpool.added[0]
+            assert ev.vote_a.height == height
+
+            # liveness: the net keeps committing despite the byzantine votes
+            h = max(n.cs.get_round_state().height for n in nodes)
+            assert _wait_all_heights(nodes, h + 2)
+        finally:
+            stop_consensus_net(nodes)
