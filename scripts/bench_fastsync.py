@@ -1,0 +1,129 @@
+"""Fast-sync replay benchmark (BASELINE.md "50k-block fast-sync replay",
+ref harness: benchmarks/blockchain/localsync.sh + blockchain/reactor.go:335).
+
+Measures the verify→apply pipeline blocks/s on a pre-built signed chain:
+  * baseline — the reference's shape: per-height serial host commit verify
+    (types/validator_set.go:273-298) + apply;
+  * ours — windowed batched device verification (verify_block_window: every
+    (height, validator) signature of a window in ONE dispatch) + apply with
+    trusted commits.
+
+Usage: python scripts/bench_fastsync.py [n_blocks] [n_vals] [window]
+Prints one JSON line: {"metric": "fastsync_replay", "value": blocks/s, ...}
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+N_BLOCKS = int(sys.argv[1]) if len(sys.argv) > 1 else 2048
+N_VALS = int(sys.argv[2]) if len(sys.argv) > 2 else 64
+WINDOW = int(sys.argv[3]) if len(sys.argv) > 3 else 512
+BASELINE_SAMPLE_BLOCKS = 64  # serial blocks to time (extrapolated)
+
+
+def _fresh_executor(genesis):
+    from tendermint_tpu.abci.examples.kvstore import KVStoreApp
+    from tendermint_tpu.libs.db.kv import MemDB
+    from tendermint_tpu.proxy.app_conn import LocalClientCreator, MultiAppConn
+    from tendermint_tpu.state import store as sm_store
+    from tendermint_tpu.state.execution import BlockExecutor
+    from tendermint_tpu.state.state_types import state_from_genesis
+
+    st = state_from_genesis(genesis)
+    db = MemDB()
+    sm_store.save_state(db, st)
+    conn = MultiAppConn(LocalClientCreator(KVStoreApp()))
+    conn.start()
+    return st, BlockExecutor(db, conn.consensus)
+
+
+def main():
+    from tendermint_tpu.crypto import batch as _batch
+    from tendermint_tpu.crypto.batch import HostBatchVerifier, TPUBatchVerifier
+    from tendermint_tpu.blockchain.reactor import verify_block_window
+    from tendermint_tpu.testutil.chain import build_chain
+    from tendermint_tpu.types import BlockID
+
+    # chain generation + the serial baseline must use the host oracle — the
+    # process default would route every per-block verify over the device
+    _batch.set_batch_verifier(HostBatchVerifier())
+
+    t0 = time.perf_counter()
+    fx = build_chain(n_vals=N_VALS, n_heights=N_BLOCKS, chain_id="bench-sync")
+    gen_s = time.perf_counter() - t0
+    blocks = [fx.block_store.load_block(h) for h in range(1, N_BLOCKS + 1)]
+    print(
+        f"# chain: {N_BLOCKS} blocks x {N_VALS} validators "
+        f"(built in {gen_s:.1f}s)", file=sys.stderr,
+    )
+
+    # --- baseline: reference-shaped serial loop (verify every commit on host,
+    # then apply) over a sample, extrapolated ---
+    st, block_exec = _fresh_executor(fx.genesis)
+    t0 = time.perf_counter()
+    for i in range(BASELINE_SAMPLE_BLOCKS):
+        block, next_block = blocks[i], blocks[i + 1]
+        parts = block.make_part_set()
+        block_id = BlockID(hash=block.hash(), parts_header=parts.header())
+        st.validators.verify_commit(
+            fx.chain_id, block_id, block.height, next_block.last_commit,
+            verifier=HostBatchVerifier(),
+        )
+        st = block_exec.apply_block(st, block_id, block, trusted_last_commit=True)
+    baseline_s = (time.perf_counter() - t0) * (N_BLOCKS / BASELINE_SAMPLE_BLOCKS)
+    print(
+        f"# baseline (serial host verify): "
+        f"{N_BLOCKS / baseline_s:.0f} blocks/s", file=sys.stderr,
+    )
+
+    # --- ours: windowed batched verify + apply ---
+    try:
+        verifier = TPUBatchVerifier()
+    except Exception:
+        verifier = HostBatchVerifier()
+    st, block_exec = _fresh_executor(fx.genesis)
+    # warm the device path (compile + upload) on the first window
+    verify_block_window(st, blocks[: min(WINDOW, len(blocks))], verifier=verifier)
+
+    t0 = time.perf_counter()
+    applied = 0
+    pos = 0
+    while pos < N_BLOCKS - 1:
+        window = blocks[pos : pos + WINDOW + 1]
+        parts_list = []
+        n_ok, err = verify_block_window(
+            st, window, verifier=verifier, parts_out=parts_list
+        )
+        if err is not None or n_ok == 0:
+            raise SystemExit(f"verification failed at {pos}: {err}")
+        for i in range(n_ok):
+            block = window[i]
+            block_id = BlockID(
+                hash=block.hash(), parts_header=parts_list[i].header()
+            )
+            st = block_exec.apply_block(
+                st, block_id, block, trusted_last_commit=True
+            )
+            applied += 1
+        pos += n_ok
+    ours_s = time.perf_counter() - t0
+    ours_rate = applied / ours_s
+
+    print(
+        json.dumps(
+            {
+                "metric": f"fastsync_replay_{N_BLOCKS}x{N_VALS}",
+                "value": round(ours_rate, 1),
+                "unit": "blocks/s",
+                "vs_baseline": round((N_BLOCKS / baseline_s) and ours_rate / (N_BLOCKS / baseline_s), 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

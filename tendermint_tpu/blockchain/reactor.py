@@ -1,0 +1,318 @@
+"""BlockchainReactor — fast sync with batched multi-height commit
+verification (ref: blockchain/reactor.go:216-327).
+
+The reference's pool routine peeks TWO blocks and serially verifies one
+commit per iteration (reactor.go:289-306 — ★ THE loop this framework exists
+to replace). Here the pool yields a whole run of consecutive blocks and all
+their commits are verified in ONE BatchVerifier dispatch — every
+(height, validator) signature of the window in a single device call
+(`verify_block_window`), with quorum tallies in numpy. The mesh-sharded
+variant of the same math lives in parallel/commit_verify.py and is exercised
+by the multi-chip dryrun.
+
+Verified blocks then apply sequentially with ``trusted_last_commit=True`` so
+the executor does not re-verify signatures the window already covered.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from tendermint_tpu.blockchain.messages import (
+    BlockRequestMessage,
+    BlockResponseMessage,
+    NoBlockResponseMessage,
+    StatusRequestMessage,
+    StatusResponseMessage,
+    encode_msg,
+    unmarshal_msg,
+)
+from tendermint_tpu.blockchain.pool import BlockPool
+from tendermint_tpu.crypto.batch import verify_generic
+from tendermint_tpu.p2p.base_reactor import Reactor
+from tendermint_tpu.p2p.conn.connection import ChannelDescriptor
+from tendermint_tpu.types import BlockID
+
+BLOCKCHAIN_CHANNEL = 0x40
+MAX_MSG_SIZE = 104857600  # 100 MB protocol block ceiling (types/params.go:11)
+
+TRY_SYNC_INTERVAL = 0.01  # reference trySyncTicker 10ms
+STATUS_UPDATE_INTERVAL = 2.0  # reference 10s; shrunk for test nets
+SWITCH_TO_CONSENSUS_INTERVAL = 0.5  # reference 1s
+VERIFY_WINDOW = 64  # heights verified per device dispatch
+
+
+class WindowVerifyError(Exception):
+    def __init__(self, bad_index: int, reason: str):
+        super().__init__(f"block window invalid at offset {bad_index}: {reason}")
+        self.bad_index = bad_index
+
+
+def verify_block_window(
+    state, blocks: List, verifier=None, parts_out: Optional[List] = None
+) -> Tuple[int, Optional[WindowVerifyError]]:
+    """Verify commits for blocks[0..n-2] (block i's commit is
+    blocks[i+1].last_commit, signed by the valset whose hash block i carries
+    — reactor.go:306's VerifyCommit, across the whole window at once).
+
+    Per-precommit validity rules + power collection are shared with the
+    single-commit path (ValidatorSet.collect_commit_sigs) so the two
+    verifiers cannot drift apart.
+
+    Returns (n_verified, err): the first n_verified blocks' commits are
+    fully verified; err is set if block n_verified is *invalid* (vs merely
+    belonging to a future valset, which just truncates the window).
+    If `parts_out` is given, it receives each usable block's PartSet so the
+    apply loop doesn't rebuild it (block marshal + merkle per block).
+    """
+    from tendermint_tpu.types.validator_set import CommitError
+
+    valset = state.validators
+    chain_id = state.chain_id
+    n = len(blocks) - 1
+    if n <= 0:
+        return 0, None
+
+    # 1. host prechecks + truncation at the first valset change
+    usable = 0
+    structural: Optional[WindowVerifyError] = None
+    all_pubkeys: List = []
+    all_msgs: List[bytes] = []
+    all_sigs: List[bytes] = []
+    # per-height bookkeeping: (start offset, power vector)
+    spans: List[Tuple[int, List[int]]] = []
+    for i in range(n):
+        block, next_block = blocks[i], blocks[i + 1]
+        if block.header.validators_hash != valset.hash():
+            if i == 0:
+                # offset 0 is always OUR current valset; a mismatch there is
+                # a bad block, not a future valset — punishable, else the
+                # same block livelocks the sync loop forever
+                structural = WindowVerifyError(0, "wrong validators_hash")
+            break  # valset changed: verify the rest after applying up to here
+        commit = next_block.last_commit
+        parts = block.make_part_set()
+        block_id = BlockID(hash=block.hash(), parts_header=parts.header())
+        try:
+            pubkeys, msgs, sigs, powers = valset.collect_commit_sigs(
+                chain_id, block_id, block.height, commit
+            )
+        except CommitError as e:
+            structural = WindowVerifyError(i, str(e))
+            break
+        start = len(all_pubkeys)
+        all_pubkeys.extend(pubkeys)
+        all_msgs.extend(msgs)
+        all_sigs.extend(sigs)
+        spans.append((start, powers))
+        if parts_out is not None:
+            parts_out.append(parts)
+        usable += 1
+
+    if usable == 0:
+        return 0, structural
+
+    # 2. ONE batched dispatch for the whole window (ed25519 rides the device;
+    # other key types fall back to host inside verify_generic)
+    ok = verify_generic(all_pubkeys, all_msgs, all_sigs, verifier=verifier)
+
+    # 3. per-height quorum tallies; stop at the first invalid commit
+    quorum_bar = valset.total_voting_power() * 2
+    for i in range(usable):
+        start, powers = spans[i]
+        sl = ok[start : start + len(powers)]
+        if not bool(np.all(sl)):
+            if parts_out is not None:
+                del parts_out[i:]
+            return i, WindowVerifyError(i, "invalid signature in commit")
+        if int(np.dot(sl, np.asarray(powers, dtype=np.int64))) * 3 <= quorum_bar:
+            if parts_out is not None:
+                del parts_out[i:]
+            return i, WindowVerifyError(i, "insufficient voting power")
+    return usable, structural
+
+
+class BlockchainReactor(Reactor):
+    def __init__(
+        self,
+        state,  # sm.State — the sync starting point
+        block_exec,  # BlockExecutor
+        block_store,
+        fast_sync: bool = True,
+        consensus_reactor=None,  # .switch_to_consensus(state, n) when caught up
+        verifier=None,  # BatchVerifier for the window dispatches
+        verify_window: int = VERIFY_WINDOW,
+    ):
+        super().__init__(name="BlockchainReactor")
+        self.initial_state = state
+        self.state = state.copy()
+        self.block_exec = block_exec
+        self.store = block_store
+        self.fast_sync = fast_sync
+        self.consensus_reactor = consensus_reactor
+        self.verifier = verifier
+        self.verify_window = verify_window
+        self.pool = BlockPool(
+            start_height=self.store.height() + 1,
+            request_cb=self._send_block_request,
+            error_cb=self._stop_peer_by_id,
+        )
+        self.blocks_synced = 0
+        self._trusted_commit_heights: set = set()
+        self._switched = threading.Event()
+
+    # -- Reactor interface --------------------------------------------------------
+    def get_channels(self):
+        return [
+            ChannelDescriptor(
+                id=BLOCKCHAIN_CHANNEL, priority=10, send_queue_capacity=1000,
+                recv_message_capacity=MAX_MSG_SIZE,
+            )
+        ]
+
+    def on_start(self) -> None:
+        if self.fast_sync:
+            self.pool.start()
+            threading.Thread(
+                target=self._pool_routine, name="bc-pool", daemon=True
+            ).start()
+
+    def on_stop(self) -> None:
+        if self.pool.is_running:
+            try:
+                self.pool.stop()
+            except Exception:
+                pass
+
+    def add_peer(self, peer) -> None:
+        peer.try_send(
+            BLOCKCHAIN_CHANNEL, encode_msg(StatusResponseMessage(self.store.height()))
+        )
+
+    def remove_peer(self, peer, reason) -> None:
+        self.pool.remove_peer(peer.id)
+
+    def receive(self, chan_id: int, peer, msg_bytes: bytes) -> None:
+        msg = unmarshal_msg(msg_bytes)
+        if isinstance(msg, BlockRequestMessage):
+            block = self.store.load_block(msg.height)
+            if block is not None:
+                peer.try_send(BLOCKCHAIN_CHANNEL, encode_msg(BlockResponseMessage(block)))
+            else:
+                peer.try_send(
+                    BLOCKCHAIN_CHANNEL, encode_msg(NoBlockResponseMessage(msg.height))
+                )
+        elif isinstance(msg, BlockResponseMessage):
+            self.pool.add_block(peer.id, msg.block)
+        elif isinstance(msg, NoBlockResponseMessage):
+            self.pool.no_block(peer.id, msg.height)
+        elif isinstance(msg, StatusRequestMessage):
+            peer.try_send(
+                BLOCKCHAIN_CHANNEL, encode_msg(StatusResponseMessage(self.store.height()))
+            )
+        elif isinstance(msg, StatusResponseMessage):
+            self.pool.set_peer_height(peer.id, msg.height)
+        else:
+            self.logger.error("unknown blockchain msg %r", type(msg))
+
+    # -- pool callbacks --------------------------------------------------------------
+    def _send_block_request(self, height: int, peer_id: str) -> None:
+        peer = self.switch.peers.get(peer_id) if self.switch else None
+        if peer is None:
+            self.pool.remove_peer(peer_id)
+            return
+        peer.try_send(BLOCKCHAIN_CHANNEL, encode_msg(BlockRequestMessage(height)))
+
+    def _stop_peer_by_id(self, peer_id: str, reason: str) -> None:
+        peer = self.switch.peers.get(peer_id) if self.switch else None
+        if peer is not None:
+            self.switch.stop_peer_for_error(peer, reason)
+        else:
+            self.pool.remove_peer(peer_id)
+
+    # -- the sync loop ---------------------------------------------------------------
+    def _pool_routine(self) -> None:
+        """reactor.go:216 poolRoutine — with windowed verify→apply."""
+        last_status = 0.0
+        last_switch_check = 0.0
+        while not self._quit.is_set():
+            now = time.monotonic()
+            if now - last_status > STATUS_UPDATE_INTERVAL:
+                last_status = now
+                if self.switch is not None:
+                    self.switch.broadcast(
+                        BLOCKCHAIN_CHANNEL,
+                        encode_msg(StatusRequestMessage(self.store.height())),
+                    )
+            if now - last_switch_check > SWITCH_TO_CONSENSUS_INTERVAL:
+                last_switch_check = now
+                if self.pool.is_caught_up() and self.pool.num_peers() > 0:
+                    self._switch_to_consensus()
+                    return
+            try:
+                self._try_sync_window()
+            except Exception:
+                self.logger.exception("fast sync window failed")
+            self._quit.wait(TRY_SYNC_INTERVAL)
+
+    def _try_sync_window(self) -> None:
+        blocks = self.pool.peek_window(self.verify_window + 1)
+        if len(blocks) < 2:
+            return
+        parts_list: list = []
+        n_ok, err = verify_block_window(
+            self.state, blocks, verifier=self.verifier, parts_out=parts_list
+        )
+        for i in range(n_ok):
+            self._trusted_commit_heights.add(blocks[i].height)
+        if err is not None:
+            bad = blocks[err.bad_index]
+            self.logger.error("invalid block %d in sync: %s", bad.height, err)
+            # punish whoever supplied the bad block and its commit source
+            for h in (bad.height, bad.height + 1):
+                peer_id = self.pool.redo_request(h)
+                if peer_id:
+                    self._stop_peer_by_id(peer_id, f"sent bad block {h}")
+        # apply the verified prefix
+        for i in range(n_ok):
+            block = blocks[i]
+            parts = parts_list[i]
+            block_id = BlockID(hash=block.hash(), parts_header=parts.header())
+            self.store.save_block(block, parts, blocks[i + 1].last_commit)
+            # the first synced block's own LastCommit predates our batches —
+            # its membership check below is False, forcing the full verify
+            self.state = self.block_exec.apply_block(
+                self.state, block_id, block,
+                trusted_last_commit=block.height - 1 in self._trusted_commit_heights,
+            )
+            self.pool.pop_first()
+            self.blocks_synced += 1
+            self._trusted_commit_heights.discard(block.height - 2)
+            if self.blocks_synced % 100 == 0:
+                self.logger.info(
+                    "fast sync at height %d (%d peers)",
+                    self.pool.height, self.pool.num_peers(),
+                )
+
+    def _switch_to_consensus(self) -> None:
+        if self._switched.is_set():
+            return
+        self._switched.set()
+        self.logger.info(
+            "caught up (height %d, synced %d) — switching to consensus",
+            self.store.height(), self.blocks_synced,
+        )
+        self.fast_sync = False
+        if self.pool.is_running:
+            try:
+                self.pool.stop()
+            except Exception:
+                pass
+        if self.consensus_reactor is not None:
+            self.consensus_reactor.switch_to_consensus(
+                self.state.copy(), self.blocks_synced
+            )

@@ -711,6 +711,10 @@ class ConsensusState(BaseService):
                     rs.proposal_block = None
                     rs.proposal_block_parts = PartSet(block_id.parts_header)
                     self._publish_rs_event(EVENT_VALID_BLOCK)
+                    # evsw too: the reactor rebroadcasts our (empty) parts
+                    # bitmap so peers that already marked parts as sent-to-us
+                    # resend them (state.go:1226 FireEvent EventValidBlock)
+                    self.evsw.fire_event(EVENT_VALID_BLOCK, self.get_round_state())
         finally:
             self._update_round_step(rs.round, RoundStepType.COMMIT)
             rs.commit_round = commit_round

@@ -90,8 +90,13 @@ class PubKeyEd25519(PubKey):
             raise ValueError("ed25519 pubkey must be 32 bytes")
 
     def address(self) -> bytes:
-        # reference crypto/ed25519/ed25519.go:138 — SHA256(pubkey)[:20]
-        return tmhash_truncated(self.data)
+        # reference crypto/ed25519/ed25519.go:138 — SHA256(pubkey)[:20];
+        # memoized: address() sits under every valset sort/lookup
+        addr = self.__dict__.get("_addr")
+        if addr is None:
+            addr = tmhash_truncated(self.data)
+            object.__setattr__(self, "_addr", addr)
+        return addr
 
     def bytes(self) -> bytes:
         return self.data

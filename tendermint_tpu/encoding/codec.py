@@ -26,23 +26,27 @@ import struct
 from typing import List, Sequence, Tuple
 
 
+_B1 = [bytes([i]) for i in range(0x80)]  # single-byte uvarints (the hot case)
+
+
 def write_uvarint(buf: io.BytesIO, n: int) -> None:
+    buf.write(encode_uvarint(n))
+
+
+def encode_uvarint(n: int) -> bytes:
+    if 0 <= n < 0x80:
+        return _B1[n]
     if n < 0:
         raise ValueError("uvarint must be non-negative")
+    out = bytearray()
     while True:
         b = n & 0x7F
         n >>= 7
         if n:
-            buf.write(bytes([b | 0x80]))
+            out.append(b | 0x80)
         else:
-            buf.write(bytes([b]))
-            return
-
-
-def encode_uvarint(n: int) -> bytes:
-    buf = io.BytesIO()
-    write_uvarint(buf, n)
-    return buf.getvalue()
+            out.append(b)
+            return bytes(out)
 
 
 def read_uvarint(buf: io.BytesIO) -> int:
@@ -123,41 +127,48 @@ def read_length_prefixed(buf: io.BytesIO) -> bytes:
 
 
 class Writer:
-    """Ordered-field struct writer; every encoder in types/ uses this."""
+    """Ordered-field struct writer; every encoder in types/ uses this.
+    Backed by a bytearray — this is the hottest object in block
+    application/serialization."""
+
+    __slots__ = ("_buf",)
 
     def __init__(self) -> None:
-        self._buf = io.BytesIO()
+        self._buf = bytearray()
 
     def uvarint(self, n: int) -> "Writer":
-        write_uvarint(self._buf, n)
+        buf = self._buf
+        if 0 <= n < 0x80:
+            buf.append(n)
+            return self
+        buf += encode_uvarint(n)
         return self
 
     def svarint(self, n: int) -> "Writer":
-        self._buf.write(encode_svarint(n))
-        return self
+        return self.uvarint((n << 1) ^ (n >> 63) if n < 0 else n << 1)
 
     def fixed64(self, n: int) -> "Writer":
-        self._buf.write(encode_fixed64(n))
+        self._buf += struct.pack("<q", n)
         return self
 
     def bytes(self, b: bytes) -> "Writer":
-        self._buf.write(encode_bytes(b))
+        self.uvarint(len(b))
+        self._buf += b
         return self
 
     def string(self, s: str) -> "Writer":
-        self._buf.write(encode_string(s))
-        return self
+        return self.bytes(s.encode("utf-8"))
 
     def bool(self, v: bool) -> "Writer":
-        self._buf.write(encode_bool(v))
+        self._buf.append(1 if v else 0)
         return self
 
     def raw(self, b: bytes) -> "Writer":
-        self._buf.write(b)
+        self._buf += b
         return self
 
     def build(self) -> bytes:
-        return self._buf.getvalue()
+        return bytes(self._buf)
 
 
 class Reader:

@@ -57,14 +57,28 @@ class BlockExecutor:
 
         self.logger = logger or logging.getLogger("tm.state")
 
-    def validate_block(self, state: State, block: Block) -> None:
-        validate_block(self.db, state, block, verifier=self.verifier)
+    def validate_block(
+        self, state: State, block: Block, trusted_last_commit: bool = False
+    ) -> None:
+        validate_block(
+            self.db, state, block, verifier=self.verifier,
+            trusted_last_commit=trusted_last_commit,
+        )
 
-    def apply_block(self, state: State, block_id: BlockID, block: Block) -> State:
+    def apply_block(
+        self,
+        state: State,
+        block_id: BlockID,
+        block: Block,
+        trusted_last_commit: bool = False,
+    ) -> State:
         """execution.go:88 — returns the new state or raises; the caller dies
-        on failure (consensus halts deliberately)."""
+        on failure (consensus halts deliberately).
+
+        trusted_last_commit: fast sync's batched window verify already checked
+        this block's LastCommit signatures — skip re-verifying them."""
         try:
-            self.validate_block(state, block)
+            self.validate_block(state, block, trusted_last_commit=trusted_last_commit)
         except Exception as e:
             raise InvalidBlockError(str(e)) from e
 

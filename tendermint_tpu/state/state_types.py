@@ -109,8 +109,10 @@ class State:
             if vs is None:
                 w.bool(False)
             else:
+                # vs.marshal() == the bytes vs.encode(w) would write, but
+                # memoized (two of these three sets are unchanged per block)
                 w.bool(True)
-                vs.encode(w)
+                w.raw(vs.marshal())
         w.svarint(self.last_height_validators_changed)
         self.consensus_params.encode(w)
         w.svarint(self.last_height_consensus_params_changed)

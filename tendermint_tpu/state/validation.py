@@ -25,7 +25,16 @@ class EvidenceInvalidError(Exception):
     pass
 
 
-def validate_block(state_db: DB, state: State, block: Block, verifier=None) -> None:
+def validate_block(
+    state_db: DB,
+    state: State,
+    block: Block,
+    verifier=None,
+    trusted_last_commit: bool = False,
+) -> None:
+    """trusted_last_commit: skip only the LastCommit *signature* verification
+    (structural/size/time checks still run) — set by fast sync after its
+    batched multi-height window verify already covered those signatures."""
     block.validate_basic()
 
     # basic info
@@ -75,10 +84,11 @@ def validate_block(state_db: DB, state: State, block: Block, verifier=None) -> N
                 f"invalid commit size: expected {state.last_validators.size}, "
                 f"got {len(block.last_commit.precommits)}"
             )
-        state.last_validators.verify_commit(
-            state.chain_id, state.last_block_id, block.header.height - 1,
-            block.last_commit, verifier=verifier,
-        )
+        if not trusted_last_commit:
+            state.last_validators.verify_commit(
+                state.chain_id, state.last_block_id, block.header.height - 1,
+                block.last_commit, verifier=verifier,
+            )
 
     # block time: BFT median of LastCommit (validation.go:117-141)
     if block.header.height > 1:

@@ -1,0 +1,3 @@
+from tendermint_tpu.testutil.chain import ChainFixture, build_chain
+
+__all__ = ["ChainFixture", "build_chain"]

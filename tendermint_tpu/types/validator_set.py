@@ -17,7 +17,7 @@ from typing import Callable, Iterable, List, Optional, Sequence, Tuple
 
 from tendermint_tpu.crypto import merkle
 from tendermint_tpu.crypto.batch import verify_generic
-from tendermint_tpu.crypto.keys import PubKey, pubkey_from_json_obj
+from tendermint_tpu.crypto.keys import PubKey
 from tendermint_tpu.encoding.codec import Reader, Writer
 from tendermint_tpu.types.core import BlockID, SignedMsgType
 from tendermint_tpu.types.vote import Vote
@@ -59,16 +59,18 @@ class Validator:
         return w.build()
 
     def encode(self, w: Writer) -> None:
-        import json
-
-        w.string(json.dumps(self.pub_key.to_json_obj(), sort_keys=True))
+        # binary (type name + raw key): this runs 3×/valset on every
+        # save_state — the JSON/base64 form it replaced was the single
+        # hottest line of fast-sync block application
+        w.string(self.pub_key.type_name)
+        w.bytes(self.pub_key.bytes())
         w.svarint(self.voting_power).svarint(self.accum)
 
     @classmethod
     def decode(cls, r: Reader) -> "Validator":
-        import json
+        from tendermint_tpu.crypto.keys import _PUBKEY_TYPES
 
-        pk = pubkey_from_json_obj(json.loads(r.string()))
+        pk = _PUBKEY_TYPES[r.string()](r.bytes())
         return cls(pub_key=pk, voting_power=r.svarint(), accum=r.svarint())
 
 
@@ -82,6 +84,9 @@ class ValidatorSet:
         self.proposer: Optional[Validator] = None
         self._total_voting_power: Optional[int] = None
         self._addresses: Optional[List[bytes]] = None  # sorted, lazy
+        self._hash: Optional[bytes] = None  # memoized; accum-independent
+        self._mver = 0  # bumped on any accum/membership change
+        self._marshal_cache: Optional[Tuple[int, bytes]] = None
         if vals:
             self.increment_accum(1)
 
@@ -96,6 +101,8 @@ class ValidatorSet:
         self.proposer = None
         self._total_voting_power = None
         self._addresses = None
+        self._hash = None
+        self._mver += 1
 
     # size / lookup --------------------------------------------------------
     @property
@@ -136,6 +143,9 @@ class ValidatorSet:
             return None
         if self.proposer is None:
             self.proposer = self._find_proposer()
+            # marshal() encodes the proposer index: a cache filled while
+            # proposer was unset would persist prop_idx=-1 nondeterministically
+            self._mver += 1
         return self.proposer.copy()
 
     def _find_proposer(self) -> Validator:
@@ -149,6 +159,7 @@ class ValidatorSet:
         becomes proposer, minus totalPower (ref validator_set.go:65-88)."""
         if not self.validators:
             raise ValueError("empty validator set")
+        self._mver += 1  # accums change -> cached marshal bytes stale
         for v in self.validators:
             v.accum = _clip(v.accum + _clip(v.voting_power * times))
         for i in range(times):
@@ -163,6 +174,13 @@ class ValidatorSet:
         new.proposer = self.proposer
         new._total_voting_power = self._total_voting_power
         new._addresses = None
+        new._hash = self._hash  # membership identical; accum changes don't matter
+        new._mver = 0
+        new._marshal_cache = (
+            (0, self._marshal_cache[1])
+            if self._marshal_cache is not None and self._marshal_cache[0] == self._mver
+            else None
+        )
         return new
 
     def copy_increment_accum(self, times: int) -> "ValidatorSet":
@@ -201,18 +219,21 @@ class ValidatorSet:
 
     # hashing --------------------------------------------------------------
     def hash(self) -> bytes:
-        return merkle.hash_from_byte_slices(
-            [v.hash_bytes() for v in self.validators]
-        )
+        if self._hash is None:
+            self._hash = merkle.hash_from_byte_slices(
+                [v.hash_bytes() for v in self.validators]
+            )
+        return self._hash
 
     # THE hot path ---------------------------------------------------------
-    def verify_commit(
-        self, chain_id: str, block_id: BlockID, height: int, commit, verifier=None
-    ) -> None:
-        """Raise unless +2/3 of this set signed blockID at height.
-
-        One BatchVerifier dispatch for all non-nil precommits (the reference
-        loops serially at validator_set.go:273-298)."""
+    def collect_commit_sigs(
+        self, chain_id: str, block_id: BlockID, height: int, commit
+    ) -> Tuple[List[PubKey], List[bytes], List[bytes], List[int]]:
+        """Structural checks + (pubkeys, msgs, sigs, powers) for every non-nil
+        precommit; powers[j] is 0 for precommits voting a different block.
+        The ONE place the per-precommit validity rules live — shared by the
+        single-commit path below and fast sync's windowed batch
+        (blockchain/reactor.verify_block_window). Raises CommitError."""
         if self.size != len(commit.precommits):
             raise CommitError(
                 f"wrong set size: {self.size} vs {len(commit.precommits)}"
@@ -223,7 +244,7 @@ class ValidatorSet:
             raise CommitError("wrong block id")
 
         round = commit.round()
-        idxs, pubkeys, msgs, sigs = [], [], [], []
+        pubkeys, msgs, sigs, powers = [], [], [], []
         for idx, precommit in enumerate(commit.precommits):
             if precommit is None:
                 continue
@@ -234,20 +255,30 @@ class ValidatorSet:
             if precommit.vote_type != SignedMsgType.PRECOMMIT:
                 raise CommitError(f"not a precommit @ index {idx}")
             val = self.validators[idx]
-            idxs.append(idx)
             pubkeys.append(val.pub_key)
             msgs.append(precommit.sign_bytes(chain_id))
             sigs.append(precommit.signature)
+            powers.append(
+                val.voting_power if block_id == precommit.block_id else 0
+            )
+        return pubkeys, msgs, sigs, powers
 
+    def verify_commit(
+        self, chain_id: str, block_id: BlockID, height: int, commit, verifier=None
+    ) -> None:
+        """Raise unless +2/3 of this set signed blockID at height.
+
+        One BatchVerifier dispatch for all non-nil precommits (the reference
+        loops serially at validator_set.go:273-298)."""
+        pubkeys, msgs, sigs, powers = self.collect_commit_sigs(
+            chain_id, block_id, height, commit
+        )
         ok = verify_generic(pubkeys, msgs, sigs, verifier=verifier)
         tallied = 0
-        for j, idx in enumerate(idxs):
+        for j in range(len(pubkeys)):
             if not ok[j]:
-                raise CommitError(
-                    f"invalid signature: {commit.precommits[idx]}"
-                )
-            if block_id == commit.precommits[idx].block_id:
-                tallied += self.validators[idx].voting_power
+                raise CommitError("invalid signature in commit")
+            tallied += powers[j]
 
         if tallied * 3 <= self.total_voting_power() * 2:
             raise CommitError(
@@ -313,9 +344,15 @@ class ValidatorSet:
         w.svarint(prop_idx)
 
     def marshal(self) -> bytes:
+        """Memoized until accum/membership changes — save_state re-encodes
+        three valsets per block and two of them are always unchanged."""
+        if self._marshal_cache is not None and self._marshal_cache[0] == self._mver:
+            return self._marshal_cache[1]
         w = Writer()
         self.encode(w)
-        return w.build()
+        out = w.build()
+        self._marshal_cache = (self._mver, out)
+        return out
 
     @classmethod
     def decode(cls, r: Reader) -> "ValidatorSet":
@@ -326,6 +363,9 @@ class ValidatorSet:
         vs.validators = vals
         vs._total_voting_power = None
         vs._addresses = None
+        vs._hash = None
+        vs._mver = 0
+        vs._marshal_cache = None
         vs.proposer = vals[prop_idx] if 0 <= prop_idx < len(vals) else None
         return vs
 

@@ -100,34 +100,45 @@ class TestByzantine:
             # deliver both to one honest peer's reactor (byzantine_test.go:29
             # sends conflicting msgs to different peers; same-peer delivery
             # guarantees the conflict is observed -> DuplicateVoteEvidence)
-            rs = byz.cs.get_round_state()
-            height, round = rs.height, rs.round
-            idx, _ = rs.validators.get_by_address(byz.pv.get_pub_key().address())
-            votes = []
-            for h in (b"\xaa" * 32, b"\xbb" * 32):
-                vote = Vote(
-                    vote_type=SignedMsgType.PREVOTE,
-                    height=height,
-                    round=round,
-                    timestamp_ns=time.time_ns(),
-                    block_id=BlockID(hash=h, parts_header=PartSetHeader(1, b"\xcc" * 32)),
-                    validator_address=byz.pv.get_pub_key().address(),
-                    validator_index=idx,
-                )
-                votes.append(byz.pv.sign_vote(byz.cs.state.chain_id, vote))
-            # push both votes to the honest node as if gossiped by byz
             byz_peer_on_honest = honest.switch.peers.get(byz.switch.node_id)
             assert byz_peer_on_honest is not None
-            for v in votes:
-                honest.reactor.receive(
-                    VOTE_CHANNEL, byz_peer_on_honest, encode_msg(VoteMessage(v))
-                )
 
-            assert wait_for(
-                lambda: len(honest.cs.evpool.added) > 0, timeout=30.0
-            ), "honest node never recorded DuplicateVoteEvidence"
+            def inject_conflicting_votes():
+                """Sign two conflicting prevotes at the HONEST node's current
+                height (heights race between nodes; votes for a passed or
+                future height are dropped, so retry until a pair lands)."""
+                rs = honest.cs.get_round_state()
+                height, round = rs.height, rs.round
+                idx, _ = rs.validators.get_by_address(
+                    byz.pv.get_pub_key().address()
+                )
+                for h in (b"\xaa" * 32, b"\xbb" * 32):
+                    vote = Vote(
+                        vote_type=SignedMsgType.PREVOTE,
+                        height=height,
+                        round=round,
+                        timestamp_ns=time.time_ns(),
+                        block_id=BlockID(
+                            hash=h, parts_header=PartSetHeader(1, b"\xcc" * 32)
+                        ),
+                        validator_address=byz.pv.get_pub_key().address(),
+                        validator_index=idx,
+                    )
+                    signed = byz.pv.sign_vote(byz.cs.state.chain_id, vote)
+                    honest.reactor.receive(
+                        VOTE_CHANNEL, byz_peer_on_honest,
+                        encode_msg(VoteMessage(signed)),
+                    )
+
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline and not honest.cs.evpool.added:
+                inject_conflicting_votes()
+                wait_for(lambda: len(honest.cs.evpool.added) > 0, timeout=1.0)
+            assert honest.cs.evpool.added, (
+                "honest node never recorded DuplicateVoteEvidence"
+            )
             ev = honest.cs.evpool.added[0]
-            assert ev.vote_a.height == height
+            assert ev.vote_a.height == ev.vote_b.height
 
             # liveness: the net keeps committing despite the byzantine votes
             h = max(n.cs.get_round_state().height for n in nodes)

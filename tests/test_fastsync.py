@@ -1,0 +1,299 @@
+"""Fast sync: BlockPool, windowed batch verification, reactor sync loop,
+and the full late-joiner flow (ref: blockchain/pool_test.go, reactor_test.go,
+and the verify→apply loop at blockchain/reactor.go:216-327).
+"""
+
+import dataclasses
+import threading
+import time
+
+import pytest
+
+from tendermint_tpu.blockchain.pool import BlockPool
+from tendermint_tpu.blockchain.reactor import (
+    BlockchainReactor,
+    verify_block_window,
+)
+from tendermint_tpu.consensus.reactor import ConsensusReactor
+from tendermint_tpu.libs.db.kv import MemDB
+from tendermint_tpu.proxy.app_conn import LocalClientCreator, MultiAppConn
+from tendermint_tpu.abci.examples.kvstore import KVStoreApp
+from tendermint_tpu.blockchain.store import BlockStore
+from tendermint_tpu.state import store as sm_store
+from tendermint_tpu.state.execution import BlockExecutor
+from tendermint_tpu.state.state_types import state_from_genesis
+from tendermint_tpu.testutil.chain import build_chain
+
+from tests.consensus_harness import make_cs_from_genesis, wait_for
+
+
+# ---------------------------------------------------------------------------
+# verify_block_window
+# ---------------------------------------------------------------------------
+
+
+class TestVerifyBlockWindow:
+    @pytest.fixture(scope="class")
+    def fx(self):
+        return build_chain(n_vals=4, n_heights=12, chain_id="vbw-chain")
+
+    def _blocks(self, fx):
+        return [fx.block_store.load_block(h) for h in range(1, fx.height + 1)]
+
+    def test_valid_window_verifies_all(self, fx):
+        st = state_from_genesis(fx.genesis)
+        blocks = self._blocks(fx)
+        n_ok, err = verify_block_window(st, blocks)
+        assert err is None
+        assert n_ok == len(blocks) - 1
+
+    def test_tampered_signature_detected_at_offset(self, fx):
+        st = state_from_genesis(fx.genesis)
+        blocks = self._blocks(fx)  # load_block returns fresh objects
+        pc = blocks[5].last_commit.precommits[0]
+        blocks[5].last_commit.precommits[0] = dataclasses.replace(
+            pc, signature=b"\x00" * 64
+        )
+        n_ok, err = verify_block_window(st, blocks)
+        assert n_ok == 4
+        assert err is not None and err.bad_index == 4
+
+    def test_commit_for_wrong_block_rejected(self, fx):
+        st = state_from_genesis(fx.genesis)
+        blocks = self._blocks(fx)
+        # point block 3's commit at a bogus block id
+        blocks[3].last_commit.block_id = dataclasses.replace(
+            blocks[3].last_commit.block_id, hash=b"\xde" * 32
+        )
+        n_ok, err = verify_block_window(st, blocks)
+        assert n_ok == 2
+        assert err is not None and err.bad_index == 2
+
+    def test_insufficient_quorum_rejected(self, fx):
+        st = state_from_genesis(fx.genesis)
+        blocks = self._blocks(fx)
+        # keep only 2 of 4 precommits (20 of 40 power: not > 2/3)
+        pcs = blocks[8].last_commit.precommits
+        pcs[2] = None
+        pcs[3] = None
+        n_ok, err = verify_block_window(st, blocks)
+        assert n_ok == 7
+        assert err is not None and "voting power" in str(err)
+
+    def test_single_block_window_verifies_nothing(self, fx):
+        st = state_from_genesis(fx.genesis)
+        blocks = self._blocks(fx)[:1]
+        n_ok, err = verify_block_window(st, blocks)
+        assert (n_ok, err) == (0, None)
+
+
+# ---------------------------------------------------------------------------
+# BlockPool
+# ---------------------------------------------------------------------------
+
+
+class _FakeBlock:
+    def __init__(self, height):
+        self.height = height
+
+
+class TestBlockPool:
+    def _pool(self, start=1, timeout=0.3):
+        requests = []
+        errors = []
+        pool = BlockPool(
+            start_height=start,
+            request_cb=lambda h, p: requests.append((h, p)),
+            error_cb=lambda p, r: errors.append((p, r)),
+            request_timeout=timeout,
+        )
+        pool.start()
+        return pool, requests, errors
+
+    def test_requests_fan_out_and_blocks_flow(self):
+        pool, requests, errors = self._pool()
+        try:
+            pool.set_peer_height("peerA", 10)
+            assert wait_for(lambda: len(requests) >= 10, timeout=5)
+            assert {h for h, _ in requests} == set(range(1, 11))
+            for h, peer in requests:
+                assert pool.add_block(peer, _FakeBlock(h))
+            window = pool.peek_window(100)
+            assert [b.height for b in window] == list(range(1, 11))
+            for _ in range(10):
+                pool.pop_first()
+            assert pool.is_caught_up()
+            assert not errors
+        finally:
+            pool.stop()
+
+    def test_unsolicited_block_rejected(self):
+        pool, requests, _ = self._pool()
+        try:
+            pool.set_peer_height("peerA", 5)
+            assert wait_for(lambda: len(requests) >= 5, timeout=5)
+            assert not pool.add_block("stranger", _FakeBlock(1))
+            assert not pool.add_block("peerA", _FakeBlock(99))
+        finally:
+            pool.stop()
+
+    def test_timeout_reassigns_and_reports_peer(self):
+        pool, requests, errors = self._pool(timeout=0.2)
+        try:
+            pool.set_peer_height("slow", 3)
+            assert wait_for(lambda: len(requests) >= 3, timeout=5)
+            # never respond; a second peer appears
+            pool.set_peer_height("fast", 3)
+            assert wait_for(
+                lambda: any(p == "slow" for p, _ in errors), timeout=5
+            ), "slow peer never reported"
+            assert wait_for(
+                lambda: any(p == "fast" for _, p in requests), timeout=5
+            ), "requests never reassigned"
+        finally:
+            pool.stop()
+
+    def test_redo_request_identifies_bad_peer(self):
+        pool, requests, _ = self._pool()
+        try:
+            pool.set_peer_height("badpeer", 2)
+            assert wait_for(lambda: len(requests) >= 2, timeout=5)
+            assert pool.add_block("badpeer", _FakeBlock(1))
+            assert pool.redo_request(1) == "badpeer"
+            assert pool.peek_window(10) == []
+        finally:
+            pool.stop()
+
+
+# ---------------------------------------------------------------------------
+# Full fast-sync integration: late joiner catches a live single-val chain
+# ---------------------------------------------------------------------------
+
+
+def _make_serving_node(fx):
+    """A node that serves fx's chain over the blockchain channel (its own
+    consensus idle — the chain's validators aren't running)."""
+    state_db = MemDB()
+    sm_store.save_state(state_db, fx.state)
+    conn = MultiAppConn(LocalClientCreator(KVStoreApp()))
+    conn.start()
+    block_exec = BlockExecutor(state_db, conn.consensus)
+    return BlockchainReactor(
+        fx.state.copy(), block_exec, fx.block_store, fast_sync=False
+    )
+
+
+def _make_syncing_node(genesis):
+    st = state_from_genesis(genesis)
+    state_db = MemDB()
+    sm_store.save_state(state_db, st)
+    conn = MultiAppConn(LocalClientCreator(KVStoreApp()))
+    conn.start()
+    from tendermint_tpu.mempool.mempool import Mempool
+    from tendermint_tpu.state.services import MockEvidencePool
+    from tendermint_tpu.types.events import EventBus
+    from tendermint_tpu.config.config import test_config
+    from tendermint_tpu.consensus.state import ConsensusState
+
+    mempool = Mempool(conn.mempool)
+    evpool = MockEvidencePool()
+    store = BlockStore(MemDB())
+    bus = EventBus()
+    bus.start()
+    block_exec = BlockExecutor(state_db, conn.consensus, mempool, evpool, bus)
+    cs = ConsensusState(
+        test_config().consensus, st.copy(), block_exec, store, mempool, evpool
+    )
+    cs.set_event_bus(bus)
+    cons_reactor = ConsensusReactor(cs, fast_sync=True)
+    bc_reactor = BlockchainReactor(
+        st.copy(), block_exec, store, fast_sync=True, consensus_reactor=cons_reactor
+    )
+    return bc_reactor, cons_reactor, store
+
+
+class TestFastSyncIntegration:
+    def test_late_joiner_syncs_chain_and_switches_to_consensus(self):
+        from tendermint_tpu.p2p.test_util import make_connected_switches
+
+        fx = build_chain(n_vals=4, n_heights=30, chain_id="sync-chain")
+        server = _make_serving_node(fx)
+        bc, cons, store = _make_syncing_node(fx.genesis)
+
+        reactors = [
+            lambda sw: sw.add_reactor("blockchain", server),
+            lambda sw: (sw.add_reactor("blockchain", bc), sw.add_reactor("consensus", cons)),
+        ]
+        switches = make_connected_switches(
+            2, lambda i, sw: (reactors[i](sw), sw)[1], network="sync-chain"
+        )
+        try:
+            # syncs 29 of 30 blocks (the tip's commit lives in the future),
+            # then flips to consensus mode
+            assert wait_for(lambda: store.height() >= 29, timeout=60), store.height()
+            assert wait_for(lambda: not bc.fast_sync, timeout=30)
+            assert wait_for(lambda: cons.cons.is_running, timeout=30)
+            assert cons.cons.get_round_state().height == 30
+            assert bc.blocks_synced >= 29
+            # synced chain matches the source chain byte for byte
+            assert (
+                store.load_block(29).hash() == fx.block_store.load_block(29).hash()
+            )
+        finally:
+            for sw in switches:
+                if sw.is_running:
+                    sw.stop()
+
+    def test_live_producer_late_joiner_follows(self):
+        """Producer keeps committing while the joiner syncs; after switching
+        to consensus the joiner follows new heights via consensus gossip."""
+        from tendermint_tpu.p2p.test_util import make_connected_switches
+        from tests.consensus_harness import make_genesis
+
+        from tendermint_tpu.config.config import test_config
+
+        doc, pvs = make_genesis(1)
+        # producer: real single-validator consensus + serving blockchain
+        # reactor, paced at ~5 blocks/s (a solo skip_timeout_commit producer
+        # outruns any follower by orders of magnitude)
+        cfg = test_config()
+        cfg.consensus.skip_timeout_commit = False
+        cfg.consensus.timeout_commit = 0.2
+        st0 = state_from_genesis(doc)
+        by_addr = {pv.get_pub_key().address(): pv for pv in pvs}
+        sorted_pvs = [by_addr[v.address] for v in st0.validators.validators]
+        prod_cs, prod_bus = make_cs_from_genesis(doc, sorted_pvs[0], config=cfg)
+        prod_cons = ConsensusReactor(prod_cs)
+        prod_bc = BlockchainReactor(
+            prod_cs.get_state(), prod_cs.block_exec, prod_cs.block_store,
+            fast_sync=False,
+        )
+        # joiner
+        bc, cons, store = _make_syncing_node(doc)
+
+        builders = [
+            lambda sw: (sw.add_reactor("consensus", prod_cons),
+                        sw.add_reactor("blockchain", prod_bc)),
+            lambda sw: (sw.add_reactor("consensus", cons),
+                        sw.add_reactor("blockchain", bc)),
+        ]
+        switches = make_connected_switches(
+            2, lambda i, sw: (builders[i](sw), sw)[1], network=doc.chain_id
+        )
+        try:
+            # producer commits on its own
+            assert wait_for(
+                lambda: prod_cs.get_round_state().height >= 8, timeout=60
+            )
+            # joiner syncs and then follows the live chain
+            assert wait_for(lambda: not bc.fast_sync, timeout=60)
+            assert wait_for(lambda: cons.cons.is_running, timeout=30)
+            target = prod_cs.get_round_state().height + 3
+            assert wait_for(
+                lambda: store.height() >= target - 1, timeout=60
+            ), (store.height(), prod_cs.get_round_state().height)
+        finally:
+            for sw in switches:
+                if sw.is_running:
+                    sw.stop()
+            prod_bus.stop()
