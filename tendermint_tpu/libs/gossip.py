@@ -1,0 +1,53 @@
+"""Shared wait-iteration walker for CList-backed gossip
+(the reference duplicates this loop in mempool/reactor.go:118-166 and
+evidence/reactor.go:109-160; here both reactors share one implementation).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Optional
+
+IDLE_SLEEP = 0.01
+RETRY_SLEEP = 0.1
+
+
+def walk_and_send(
+    alive: Callable[[], bool],
+    front: Callable[[], Optional[object]],
+    send: Callable[[object], bool],
+    hold_back: Optional[Callable[[object], bool]] = None,
+) -> None:
+    """Walk a CList forever, delivering each element exactly once per walker:
+
+    * ``alive()`` — loop guard (reactor + peer running);
+    * ``front()`` — list head accessor;
+    * ``send(value)`` — deliver; False = retry later;
+    * ``hold_back(value)`` — True = not yet (e.g. peer height lags).
+
+    Advancing blocks on next_wait (new elements wake the walker); a removed
+    tail anchor restarts from the front — consumers must tolerate the
+    occasional duplicate (both pools dedup)."""
+    el = None
+    while alive():
+        if el is None:
+            el = front()
+            if el is None:
+                time.sleep(IDLE_SLEEP)
+                continue
+        value = el.value
+        if hold_back is not None and hold_back(value):
+            time.sleep(RETRY_SLEEP)
+            continue
+        if not send(value):
+            time.sleep(RETRY_SLEEP)
+            continue
+        # sent exactly once — block until a successor exists
+        while alive():
+            nxt = el.next_wait(timeout=0.1)
+            if nxt is not None:
+                el = nxt
+                break
+            if el.removed:
+                el = None
+                break

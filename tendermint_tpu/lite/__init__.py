@@ -1,0 +1,18 @@
+"""Light client: trust-minimized header verification over the batched
+commit-verify path (ref: /root/reference/lite/)."""
+
+from tendermint_tpu.lite.provider import DBProvider, NodeProvider, Provider, ProviderError
+from tendermint_tpu.lite.types import FullCommit, LiteError, SignedHeader
+from tendermint_tpu.lite.verifier import BaseVerifier, DynamicVerifier
+
+__all__ = [
+    "BaseVerifier",
+    "DBProvider",
+    "DynamicVerifier",
+    "FullCommit",
+    "LiteError",
+    "NodeProvider",
+    "Provider",
+    "ProviderError",
+    "SignedHeader",
+]

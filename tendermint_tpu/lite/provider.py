@@ -1,0 +1,102 @@
+"""FullCommit providers (ref: lite/provider.go, dbprovider.go:16,
+lite/client/provider.go).
+
+* ``DBProvider`` — the persistent trust store the DynamicVerifier saves
+  verified commits into (and reloads across restarts);
+* ``NodeProvider`` — a source reading from a live/full node's block store +
+  state DB (the in-proc equivalent of the reference's HTTP client provider;
+  the RPC-backed variant plugs the same interface).
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Optional
+
+from tendermint_tpu.lite.types import FullCommit, LiteError, SignedHeader
+from tendermint_tpu.state import store as sm_store
+
+
+class ProviderError(LiteError):
+    """Commit not found (lite/errors.go ErrCommitNotFound)."""
+
+
+class Provider:
+    def latest_full_commit(
+        self, chain_id: str, min_height: int, max_height: int
+    ) -> FullCommit:
+        """The tallest FullCommit within [min_height, max_height]."""
+        raise NotImplementedError
+
+    def full_commit_at(self, chain_id: str, height: int) -> FullCommit:
+        return self.latest_full_commit(chain_id, height, height)
+
+
+class DBProvider(Provider):
+    """Trust store over the KV abstraction (lite/dbprovider.go)."""
+
+    _PREFIX = b"lite:fc:"
+
+    def __init__(self, db):
+        self._db = db
+
+    def _key(self, chain_id: str, height: int) -> bytes:
+        # big-endian height so the iterator orders numerically
+        return self._PREFIX + chain_id.encode() + b":" + struct.pack(">q", height)
+
+    def save_full_commit(self, fc: FullCommit) -> None:
+        chain_id = fc.signed_header.header.chain_id
+        self._db.set_sync(self._key(chain_id, fc.height), fc.marshal())
+
+    def latest_full_commit(
+        self, chain_id: str, min_height: int, max_height: int
+    ) -> FullCommit:
+        lo = self._key(chain_id, min_height)
+        hi = self._key(chain_id, max_height + 1)
+        # reverse iterator: decode only the tallest entry (bisection calls
+        # this on every hop — decoding the whole range would be O(N²))
+        for _, v in self._db.iterator(lo, hi, reverse=True):
+            return FullCommit.unmarshal(v)
+        raise ProviderError(
+            f"no full commit for {chain_id} in [{min_height},{max_height}]"
+        )
+
+
+class NodeProvider(Provider):
+    """Source provider over a full node's stores (block store + state DB) —
+    what the reference's lite/client fetches over RPC, served in-proc."""
+
+    def __init__(self, block_store, state_db):
+        self._store = block_store
+        self._state_db = state_db
+
+    def latest_full_commit(
+        self, chain_id: str, min_height: int, max_height: int
+    ) -> FullCommit:
+        top = min(max_height, self._store.height())
+        for h in range(top, min_height - 1, -1):
+            try:
+                return self.full_commit_at(chain_id, h)
+            except ProviderError:
+                continue
+        raise ProviderError(
+            f"no full commit for {chain_id} in [{min_height},{max_height}]"
+        )
+
+    def full_commit_at(self, chain_id: str, height: int) -> FullCommit:
+        meta = self._store.load_block_meta(height)
+        commit = self._store.load_block_commit(height) or self._store.load_seen_commit(
+            height
+        )
+        if meta is None or commit is None:
+            raise ProviderError(f"height {height} not in store")
+        try:
+            vals = sm_store.load_validators(self._state_db, height)
+            next_vals = sm_store.load_validators(self._state_db, height + 1)
+        except Exception as e:
+            raise ProviderError(f"no validators for height {height}: {e}") from e
+        return FullCommit(
+            signed_header=SignedHeader(header=meta.header, commit=commit),
+            validators=vals,
+            next_validators=next_vals,
+        )

@@ -1,0 +1,145 @@
+"""Light-client verifiers (ref: lite/base_verifier.go:18,
+dynamic_verifier.go:21).
+
+BaseVerifier certifies headers against ONE known validator set.
+DynamicVerifier tracks validator-set changes: it keeps a persistent store of
+trusted FullCommits and hops trust forward — directly when the valset hash
+chains (header.next_validators_hash), via VerifyFutureCommit when it
+changed, and by BISECTION when the change is too large for one hop
+(dynamic_verifier.go:195 updateToHeight, TooMuchChange → halve the jump).
+
+Every signature check inside rides the batched device verify path
+(ValidatorSet.verify_commit / verify_future_commit).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from tendermint_tpu.lite.provider import DBProvider, Provider, ProviderError
+from tendermint_tpu.lite.types import FullCommit, LiteError, SignedHeader
+from tendermint_tpu.types.validator_set import (
+    CommitError,
+    TooMuchChangeError,
+    ValidatorSet,
+)
+
+
+class BaseVerifier:
+    """Static-valset certifier (base_verifier.go)."""
+
+    def __init__(self, chain_id: str, height: int, valset: ValidatorSet):
+        self.chain_id = chain_id
+        self.initial_height = height
+        self.valset = valset
+
+    def verify(self, signed_header: SignedHeader, verifier=None) -> None:
+        """base_verifier.go Verify: height in range, valset hash matches,
+        +2/3 of the set signed it."""
+        if signed_header.height < self.initial_height:
+            raise LiteError(
+                f"height {signed_header.height} below initial {self.initial_height}"
+            )
+        signed_header.validate_basic(self.chain_id)
+        if signed_header.header.validators_hash != self.valset.hash():
+            raise LiteError("header validators_hash != trusted valset")
+        self.valset.verify_commit(
+            self.chain_id,
+            signed_header.commit.block_id,
+            signed_header.height,
+            signed_header.commit,
+            verifier=verifier,
+        )
+
+
+class DynamicVerifier:
+    """Valset-tracking certifier with a persistent trust store
+    (dynamic_verifier.go)."""
+
+    def __init__(
+        self,
+        chain_id: str,
+        trusted: DBProvider,
+        source: Provider,
+        batch_verifier=None,
+    ):
+        self.chain_id = chain_id
+        self.trusted = trusted
+        self.source = source
+        self.batch_verifier = batch_verifier
+
+    # -- bootstrap ---------------------------------------------------------------
+    def init_from_full_commit(self, fc: FullCommit) -> None:
+        """Seed trust (e.g. from a social-consensus genesis/checkpoint)."""
+        fc.validate_full(self.chain_id)
+        self.trusted.save_full_commit(fc)
+
+    # -- certify -----------------------------------------------------------------
+    def verify(self, signed_header: SignedHeader) -> None:
+        """dynamic_verifier.go Verify: ensure a trusted FullCommit for
+        exactly this height, then certify against its valset."""
+        h = signed_header.height
+        tfc = self._trusted_at_or_below(h)
+        if tfc.height != h:
+            self._update_to_height(h)
+            tfc = self._trusted_at_or_below(h)
+            if tfc.height != h:
+                raise LiteError(f"could not establish trust at height {h}")
+        BaseVerifier(self.chain_id, tfc.height, tfc.validators).verify(
+            signed_header, verifier=self.batch_verifier
+        )
+
+    # -- trust propagation ----------------------------------------------------------
+    def _trusted_at_or_below(self, h: int) -> FullCommit:
+        try:
+            return self.trusted.latest_full_commit(self.chain_id, 1, h)
+        except ProviderError as e:
+            raise LiteError(
+                "no trusted full commit — seed with init_from_full_commit"
+            ) from e
+
+    def _update_to_height(self, h: int) -> None:
+        """dynamic_verifier.go:195 updateToHeight — fetch FullCommit(h) from
+        the source and extend trust to it, bisecting on TooMuchChange."""
+        fc = self.source.full_commit_at(self.chain_id, h)
+        while True:
+            tfc = self._trusted_at_or_below(h)
+            if tfc.height == h:
+                return
+            try:
+                self._verify_and_save(tfc, fc)
+                return
+            except TooMuchChangeError:
+                # too much valset churn in one hop: trust a midpoint first
+                mid = (tfc.height + h) // 2
+                if mid in (tfc.height, h):
+                    raise
+                self._update_to_height(mid)
+
+    def _verify_and_save(self, tfc: FullCommit, fc: FullCommit) -> None:
+        """One trust hop tfc -> fc (dynamic_verifier.go verifyAndSave)."""
+        if fc.height <= tfc.height:
+            raise LiteError("hop must move forward")
+        fc.validate_full(self.chain_id)
+        if tfc.next_validators.hash() == fc.validators.hash():
+            # unchanged valset: ordinary certify
+            fc.validators.verify_commit(
+                self.chain_id,
+                fc.signed_header.commit.block_id,
+                fc.height,
+                fc.signed_header.commit,
+                verifier=self.batch_verifier,
+            )
+        else:
+            # changed: new set must sign AND +2/3 of the old next-set must
+            # overlap (validator_set.go:339 VerifyFutureCommit; raises
+            # TooMuchChangeError when overlap is insufficient)
+            tfc.next_validators.verify_future_commit(
+                fc.validators,
+                self.chain_id,
+                fc.signed_header.commit.block_id,
+                fc.height,
+                fc.signed_header.commit,
+                verifier=self.batch_verifier,
+            )
+        self.trusted.save_full_commit(fc)

@@ -1,0 +1,110 @@
+"""Mempool gossip reactor, channel 0x30 (ref: mempool/reactor.go).
+
+One broadcast thread per peer walks the mempool's concurrent list with
+wait-for-next semantics (reactor.go broadcastTxRoutine:118-166): every good
+tx reaches every peer exactly once per connection, new txs wake the walkers.
+A tx is held back while the peer lags more than one height behind the height
+the tx was validated at (reactor.go:150 peerState height check). Received
+txs go through CheckTx like any RPC submission — the app is the filter.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+from tendermint_tpu.encoding.codec import Reader, Writer
+from tendermint_tpu.libs.gossip import walk_and_send
+from tendermint_tpu.mempool.mempool import Mempool, MempoolError
+from tendermint_tpu.p2p.base_reactor import Reactor
+from tendermint_tpu.p2p.conn.connection import ChannelDescriptor
+
+MEMPOOL_CHANNEL = 0x30
+MAX_MSG_SIZE = 1024 * 1024  # reactor.go maxMsgSize
+PEER_CATCHUP_SLEEP = 0.1  # reactor.go peerCatchupSleepIntervalMS
+
+
+def encode_tx_msg(tx: bytes) -> bytes:
+    w = Writer()
+    w.uvarint(1)  # TxMessage tag
+    w.bytes(tx)
+    return w.build()
+
+
+def decode_tx_msg(data: bytes) -> bytes:
+    r = Reader(data)
+    if r.uvarint() != 1:
+        raise ValueError("unknown mempool message tag")
+    return r.bytes()
+
+
+class MempoolReactor(Reactor):
+    def __init__(self, mempool: Mempool, config=None):
+        super().__init__(name="MempoolReactor")
+        self.mempool = mempool
+        self.config = config
+        # peer_id -> height getter (set via consensus reactor's PeerState when
+        # available; None = assume caught up)
+        self._peer_height_fn = {}
+        self._ph_mtx = threading.Lock()
+
+    def get_channels(self):
+        return [
+            ChannelDescriptor(
+                id=MEMPOOL_CHANNEL, priority=5, send_queue_capacity=100,
+                recv_message_capacity=MAX_MSG_SIZE,
+            )
+        ]
+
+    def set_peer_height_fn(self, peer_id: str, fn) -> None:
+        """Wire the consensus reactor's PeerState height (node composition);
+        gossip then holds txs until the peer catches up."""
+        with self._ph_mtx:
+            self._peer_height_fn[peer_id] = fn
+
+    def _peer_height(self, peer_id: str) -> Optional[int]:
+        with self._ph_mtx:
+            fn = self._peer_height_fn.get(peer_id)
+        if fn is None:
+            return None
+        try:
+            return fn()
+        except Exception:
+            return None
+
+    def add_peer(self, peer) -> None:
+        threading.Thread(
+            target=self._broadcast_tx_routine,
+            args=(peer,),
+            name=f"mempool-gossip-{peer.id[:8]}",
+            daemon=True,
+        ).start()
+
+    def remove_peer(self, peer, reason) -> None:
+        with self._ph_mtx:
+            self._peer_height_fn.pop(peer.id, None)
+        # the broadcast thread exits on peer.is_running
+
+    def receive(self, chan_id: int, peer, msg_bytes: bytes) -> None:
+        if len(msg_bytes) > MAX_MSG_SIZE:
+            raise ValueError("oversized mempool message")
+        tx = decode_tx_msg(msg_bytes)
+        try:
+            self.mempool.check_tx(tx)
+        except MempoolError:
+            pass  # dup/full/bad txs are unremarkable from gossip
+
+    # -- per-peer walker ---------------------------------------------------------
+    def _broadcast_tx_routine(self, peer) -> None:
+        def hold_back(memtx) -> bool:
+            # hold while the peer's consensus height lags the tx's height
+            h = self._peer_height(peer.id)
+            return h is not None and h < memtx.height - 1
+
+        walk_and_send(
+            alive=lambda: self.is_running and peer.is_running,
+            front=self.mempool.txs_front,
+            send=lambda memtx: peer.send(MEMPOOL_CHANNEL, encode_tx_msg(memtx.tx)),
+            hold_back=hold_back,
+        )

@@ -54,6 +54,7 @@ def build_chain(
     genesis: Optional[GenesisDoc] = None,
     pvs: Optional[List[MockPV]] = None,
     on_height: Optional[Callable[[int, State], List[bytes]]] = None,
+    extra_pvs: Optional[List[MockPV]] = None,
 ) -> ChainFixture:
     """Builds and EXECUTES a chain: every block's commit is signed by all
     validators and applied through a real BlockExecutor + app, so headers
@@ -75,8 +76,11 @@ def build_chain(
         chain_id = genesis.chain_id
 
     st = state_from_genesis(genesis)
-    # order pvs by sorted validator-set position
+    # order pvs by sorted validator-set position; extra_pvs = keys for
+    # validators that JOIN mid-chain (via app val-txs) and must sign commits
     by_addr = {pv.get_pub_key().address(): pv for pv in pv_list}
+    for pv in extra_pvs or []:
+        by_addr[pv.get_pub_key().address()] = pv
     sorted_pvs = [by_addr[v.address] for v in st.validators.validators]
 
     state_db = state_db if state_db is not None else MemDB()

@@ -84,6 +84,7 @@ def make_cs_from_genesis(
     state_db=None,
     block_store_db=None,
     app=None,
+    real_evidence_pool: bool = False,
 ) -> Tuple[ConsensusState, EventBus]:
     """One full ConsensusState (own stores, own app) for a shared genesis —
     the per-node builder the multi-node net is assembled from
@@ -96,7 +97,12 @@ def make_cs_from_genesis(
     conn = MultiAppConn(LocalClientCreator(app or KVStoreApp()))
     conn.start()
     mempool = Mempool(conn.mempool)
-    evpool = MockEvidencePool()
+    if real_evidence_pool:
+        from tendermint_tpu.evidence.pool import EvidencePool
+
+        evpool = EvidencePool(state_db, MemDB(), st.copy())
+    else:
+        evpool = MockEvidencePool()
     block_store = BlockStore(block_store_db if block_store_db is not None else MemDB())
 
     bus = EventBus()
@@ -148,6 +154,8 @@ class NetNode:
         self.reactor = reactor
         self.pv = pv
         self.switch = None
+        self.mempool_reactor = None
+        self.evidence_reactor = None
 
 
 def make_consensus_net(
@@ -155,6 +163,8 @@ def make_consensus_net(
     config=None,
     app_factory=None,
     mconfig=None,
+    with_mempool_reactor: bool = False,
+    with_evidence_reactor: bool = False,
 ) -> List[NetNode]:
     """N real ConsensusStates gossiping over in-proc connected switches —
     the reference's randConsensusNet + MakeConnectedSwitches tier
@@ -171,15 +181,32 @@ def make_consensus_net(
     nodes: List[NetNode] = []
     for i in range(n_vals):
         app = app_factory(i) if app_factory is not None else KVStoreApp()
-        cs, bus = make_cs_from_genesis(doc, sorted_pvs[i], config=cfg, app=app)
+        cs, bus = make_cs_from_genesis(
+            doc, sorted_pvs[i], config=cfg, app=app,
+            real_evidence_pool=with_evidence_reactor,
+        )
         reactor = ConsensusReactor(cs)
-        nodes.append(NetNode(cs, bus, reactor, sorted_pvs[i]))
+        node = NetNode(cs, bus, reactor, sorted_pvs[i])
+        if with_mempool_reactor:
+            from tendermint_tpu.mempool.reactor import MempoolReactor
+
+            node.mempool_reactor = MempoolReactor(cs.mempool)
+        if with_evidence_reactor:
+            from tendermint_tpu.evidence.reactor import EvidenceReactor
+
+            node.evidence_reactor = EvidenceReactor(cs.evpool)
+        nodes.append(node)
+
+    def _init(i, sw):
+        sw.add_reactor("consensus", nodes[i].reactor)
+        if nodes[i].mempool_reactor is not None:
+            sw.add_reactor("mempool", nodes[i].mempool_reactor)
+        if nodes[i].evidence_reactor is not None:
+            sw.add_reactor("evidence", nodes[i].evidence_reactor)
+        return sw
 
     switches = make_connected_switches(
-        n_vals,
-        lambda i, sw: sw.add_reactor("consensus", nodes[i].reactor) and sw,
-        network=CHAIN_ID,
-        mconfig=mconfig,
+        n_vals, _init, network=CHAIN_ID, mconfig=mconfig
     )
     for node, sw in zip(nodes, switches):
         node.switch = sw

@@ -1,0 +1,126 @@
+"""Mempool + evidence gossip reactors in the 4-node net
+(ref: mempool/reactor_test.go TestReactorBroadcastTxMessage,
+evidence/reactor_test.go TestReactorBroadcastEvidence).
+"""
+
+import time
+
+import pytest
+
+from tendermint_tpu.types import BlockID, PartSetHeader, SignedMsgType, Vote
+from tendermint_tpu.types.evidence import DuplicateVoteEvidence
+
+from tests.consensus_harness import (
+    make_consensus_net,
+    stop_consensus_net,
+    wait_for,
+)
+
+
+def _tx_committed(nodes, tx: bytes) -> bool:
+    """tx appears in a committed block of every node's store."""
+    for n in nodes:
+        found = False
+        for h in range(1, n.cs.block_store.height() + 1):
+            block = n.cs.block_store.load_block(h)
+            if block is not None and tx in [bytes(t) for t in block.data.txs]:
+                found = True
+                break
+        if not found:
+            return False
+    return True
+
+
+class TestMempoolGossip:
+    def test_tx_submitted_to_one_node_commits_via_gossip(self):
+        nodes = make_consensus_net(4, with_mempool_reactor=True)
+        try:
+            assert wait_for(
+                lambda: all(n.cs.get_round_state().height >= 2 for n in nodes),
+                timeout=60,
+            )
+            # submit to a node that is NOT the next proposer: the tx can only
+            # commit if gossip carries it to whoever proposes
+            proposer_addr = nodes[0].cs.get_round_state().validators.get_proposer().address
+            submit_to = next(
+                n for n in nodes if n.pv.get_pub_key().address() != proposer_addr
+            )
+            tx = b"gossip-me=across-the-net"
+            submit_to.cs.mempool.check_tx(tx)
+            assert wait_for(lambda: _tx_committed(nodes, tx), timeout=60)
+        finally:
+            stop_consensus_net(nodes)
+
+    def test_tx_reaches_all_mempools_before_commit(self):
+        nodes = make_consensus_net(4, with_mempool_reactor=True)
+        try:
+            # park consensus at height >=1 then inject an invalid-for-no-one tx
+            tx = b"replicated=yes"
+            nodes[2].cs.mempool.check_tx(tx)
+            # every node's mempool sees the tx via gossip (it may then be
+            # reaped+committed and removed — accept either observation)
+            def seen_or_committed():
+                count = 0
+                for n in nodes:
+                    in_pool = any(m.tx == tx for m in n.cs.mempool._txs)
+                    if in_pool or _tx_committed([n], tx):
+                        count += 1
+                return count == 4
+
+            assert wait_for(seen_or_committed, timeout=60)
+        finally:
+            stop_consensus_net(nodes)
+
+
+class TestEvidenceGossip:
+    def test_evidence_propagates_and_commits(self):
+        nodes = make_consensus_net(
+            4, with_mempool_reactor=False, with_evidence_reactor=True
+        )
+        try:
+            # wait so height-1 validators are in every state_db
+            assert wait_for(
+                lambda: all(n.cs.get_round_state().height >= 3 for n in nodes),
+                timeout=60,
+            )
+            # real double-sign by validator 1 at a committed height
+            offender = nodes[1]
+            ev_height = 2
+            rs = nodes[0].cs.get_round_state()
+            idx, _ = rs.validators.get_by_address(
+                offender.pv.get_pub_key().address()
+            )
+            votes = []
+            for h in (b"\x11" * 32, b"\x22" * 32):
+                v = Vote(
+                    vote_type=SignedMsgType.PREVOTE,
+                    height=ev_height,
+                    round=0,
+                    timestamp_ns=time.time_ns(),
+                    block_id=BlockID(hash=h, parts_header=PartSetHeader(1, b"\x33" * 32)),
+                    validator_address=offender.pv.get_pub_key().address(),
+                    validator_index=idx,
+                )
+                votes.append(offender.pv.sign_vote(nodes[0].cs.state.chain_id, v))
+            ev = DuplicateVoteEvidence(
+                pub_key=offender.pv.get_pub_key(), vote_a=votes[0], vote_b=votes[1]
+            )
+            nodes[0].cs.evpool.add_evidence(ev)
+
+            # gossip carries it to every pool...
+            def in_all_pools_or_committed():
+                ok = 0
+                for n in nodes:
+                    if n.cs.evpool.pending_evidence(-1) or n.cs.evpool.is_committed(ev):
+                        ok += 1
+                return ok == 4
+
+            assert wait_for(in_all_pools_or_committed, timeout=60)
+
+            # ...and it lands in a committed block on every node
+            def committed_everywhere():
+                return all(n.cs.evpool.is_committed(ev) for n in nodes)
+
+            assert wait_for(committed_everywhere, timeout=60)
+        finally:
+            stop_consensus_net(nodes)

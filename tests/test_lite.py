@@ -1,0 +1,172 @@
+"""Light client: BaseVerifier, DynamicVerifier with valset tracking +
+bisection, providers (ref test models: lite/base_verifier_test.go,
+dynamic_verifier_test.go, dbprovider_test.go).
+"""
+
+import base64
+
+import pytest
+
+from tendermint_tpu.abci.examples.kvstore import PersistentKVStoreApp
+from tendermint_tpu.crypto.keys import PrivKeyEd25519
+from tendermint_tpu.libs.db.kv import MemDB
+from tendermint_tpu.lite import (
+    BaseVerifier,
+    DBProvider,
+    DynamicVerifier,
+    FullCommit,
+    LiteError,
+    NodeProvider,
+    ProviderError,
+)
+from tendermint_tpu.testutil.chain import build_chain
+from tendermint_tpu.types import MockPV
+
+
+def _val_tx(pv, power: int) -> bytes:
+    return b"val:" + base64.b64encode(pv.get_pub_key().bytes()) + b"!%d" % power
+
+
+@pytest.fixture(scope="module")
+def static_chain():
+    """10 heights, fixed 4-validator set."""
+    return build_chain(n_vals=4, n_heights=10, chain_id="lite-static")
+
+
+@pytest.fixture(scope="module")
+def churn_chain():
+    """Heavy valset churn: 3 big validators join at h4, the 3 original
+    extras leave at h8 — a single trust hop from early to late heights
+    must overlap too little and force bisection."""
+    joiners = [MockPV(PrivKeyEd25519.generate(bytes([50 + i]) * 32)) for i in range(3)]
+
+    def on_height(h, st):
+        if h == 4:
+            return [_val_tx(pv, 100) for pv in joiners]
+        if h == 8:
+            # remove 3 of the 4 original (power-10) validators
+            leavers = [
+                v for v in st.validators.validators
+                if v.voting_power == 10
+            ][:3]
+            return [
+                b"val:" + base64.b64encode(v.pub_key.bytes()) + b"!0"
+                for v in leavers
+            ]
+        return []
+
+    return build_chain(
+        n_vals=4,
+        n_heights=14,
+        chain_id="lite-churn",
+        app_factory=PersistentKVStoreApp,
+        on_height=on_height,
+        extra_pvs=joiners,
+    )
+
+
+class TestBaseVerifier:
+    def test_accepts_valid_header(self, static_chain):
+        fx = static_chain
+        src = NodeProvider(fx.block_store, fx.state_db)
+        fc = src.full_commit_at(fx.chain_id, 5)
+        bv = BaseVerifier(fx.chain_id, 1, fc.validators)
+        bv.verify(fc.signed_header)
+
+    def test_rejects_wrong_valset(self, static_chain):
+        from tendermint_tpu.types.validator_set import Validator, ValidatorSet
+
+        fx = static_chain
+        src = NodeProvider(fx.block_store, fx.state_db)
+        fc = src.full_commit_at(fx.chain_id, 5)
+        strangers = ValidatorSet(
+            [
+                Validator(PrivKeyEd25519.generate(bytes([200 + i]) * 32).pub_key(), 10)
+                for i in range(4)
+            ]
+        )
+        bv = BaseVerifier(fx.chain_id, 1, strangers)
+        with pytest.raises(LiteError):
+            bv.verify(fc.signed_header)
+
+    def test_rejects_tampered_header(self, static_chain):
+        fx = static_chain
+        src = NodeProvider(fx.block_store, fx.state_db)
+        fc = src.full_commit_at(fx.chain_id, 6)
+        fc.signed_header.header.app_hash = b"\xff" * 32
+        bv = BaseVerifier(fx.chain_id, 1, fc.validators)
+        with pytest.raises(LiteError):
+            bv.verify(fc.signed_header)
+
+    def test_rejects_below_initial_height(self, static_chain):
+        fx = static_chain
+        src = NodeProvider(fx.block_store, fx.state_db)
+        fc = src.full_commit_at(fx.chain_id, 3)
+        bv = BaseVerifier(fx.chain_id, 5, fc.validators)
+        with pytest.raises(LiteError):
+            bv.verify(fc.signed_header)
+
+
+class TestDBProvider:
+    def test_save_and_latest(self, static_chain):
+        fx = static_chain
+        src = NodeProvider(fx.block_store, fx.state_db)
+        db = DBProvider(MemDB())
+        for h in (2, 5, 7):
+            db.save_full_commit(src.full_commit_at(fx.chain_id, h))
+        assert db.latest_full_commit(fx.chain_id, 1, 10).height == 7
+        assert db.latest_full_commit(fx.chain_id, 1, 6).height == 5
+        with pytest.raises(ProviderError):
+            db.latest_full_commit(fx.chain_id, 3, 4)
+        with pytest.raises(ProviderError):
+            db.latest_full_commit("other-chain", 1, 10)
+
+
+class TestDynamicVerifier:
+    def _seeded(self, fx, seed_height=1):
+        src = NodeProvider(fx.block_store, fx.state_db)
+        trusted = DBProvider(MemDB())
+        dv = DynamicVerifier(fx.chain_id, trusted, src)
+        dv.init_from_full_commit(src.full_commit_at(fx.chain_id, seed_height))
+        return dv, src
+
+    def test_verify_static_chain_tip(self, static_chain):
+        dv, src = self._seeded(static_chain)
+        tip = src.full_commit_at(static_chain.chain_id, 9)
+        dv.verify(tip.signed_header)
+
+    def test_verify_across_valset_churn_with_bisection(self, churn_chain):
+        fx = churn_chain
+        # sanity: the churn really happened (3 joined at h4, 3 left at h8)
+        assert fx.state.validators.size == 4
+        assert {v.voting_power for v in fx.state.validators.validators} == {10, 100}
+        dv, src = self._seeded(fx, seed_height=2)
+        tip = src.full_commit_at(fx.chain_id, 13)
+        dv.verify(tip.signed_header)
+        # trust store now holds intermediate commits from the bisection
+        heights = []
+        h = 13
+        while True:
+            try:
+                fc = dv.trusted.latest_full_commit(fx.chain_id, 1, h)
+            except ProviderError:
+                break
+            heights.append(fc.height)
+            h = fc.height - 1
+        assert 13 in heights
+        assert len(heights) > 2, f"expected bisection hops, got {heights}"
+
+    def test_rejects_forged_tip(self, churn_chain):
+        fx = churn_chain
+        dv, src = self._seeded(fx, seed_height=2)
+        tip = src.full_commit_at(fx.chain_id, 12)
+        tip.signed_header.header.app_hash = b"\x66" * 32
+        with pytest.raises(LiteError):
+            dv.verify(tip.signed_header)
+
+    def test_requires_seed(self, static_chain):
+        fx = static_chain
+        src = NodeProvider(fx.block_store, fx.state_db)
+        dv = DynamicVerifier(fx.chain_id, DBProvider(MemDB()), src)
+        with pytest.raises(LiteError):
+            dv.verify(src.full_commit_at(fx.chain_id, 5).signed_header)
