@@ -52,6 +52,9 @@ def main():
     # process default would route every per-block verify over the device
     _batch.set_batch_verifier(HostBatchVerifier())
 
+    if N_BLOCKS < 2:
+        raise SystemExit("need at least 2 blocks (commit N lives in block N+1)")
+
     t0 = time.perf_counter()
     fx = build_chain(n_vals=N_VALS, n_heights=N_BLOCKS, chain_id="bench-sync")
     gen_s = time.perf_counter() - t0

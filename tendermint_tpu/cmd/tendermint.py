@@ -33,9 +33,16 @@ def _config(args):
     if getattr(args, "rpc_laddr", None):
         cfg.rpc.laddr = args.rpc_laddr
     if getattr(args, "p2p_laddr", None):
-        cfg.p2p.laddr = args.p2p_laddr
+        # literal "none" disables p2p (single-node mode)
+        cfg.p2p.laddr = "" if args.p2p_laddr == "none" else args.p2p_laddr
     if getattr(args, "persistent_peers", None):
         cfg.p2p.persistent_peers = args.persistent_peers
+    if getattr(args, "timeout_commit", None) is not None:
+        cfg.consensus.timeout_commit = args.timeout_commit
+    if getattr(args, "allow_duplicate_ip", None) is not None:
+        cfg.p2p.allow_duplicate_ip = args.allow_duplicate_ip == "true"
+    if getattr(args, "fast_sync", None) is not None:
+        cfg.base.fast_sync = args.fast_sync == "true"
     return cfg
 
 
@@ -177,13 +184,18 @@ def cmd_reset_priv_validator(args) -> int:
 
 
 def cmd_testnet(args) -> int:
-    """Generate an N-validator testnet config tree (commands/testnet.go)."""
+    """Generate an N-validator testnet config tree incl. node keys and the
+    persistent-peers string for a localnet (commands/testnet.go +
+    docker-compose.yml's localnet wiring)."""
+    from tendermint_tpu.crypto.keys import PrivKeyEd25519
+    from tendermint_tpu.p2p.key import NodeKey
     from tendermint_tpu.privval.file_pv import FilePV
     from tendermint_tpu.types import GenesisDoc, GenesisValidator
 
     out = os.path.abspath(args.output_dir)
     n = args.v
-    pvs = []
+    base_port = getattr(args, "starting_port", 26656)
+    pvs, node_keys = [], []
     for i in range(n):
         node_dir = os.path.join(out, f"node{i}")
         os.makedirs(os.path.join(node_dir, "config"), exist_ok=True)
@@ -191,6 +203,9 @@ def cmd_testnet(args) -> int:
         pvs.append(
             FilePV.generate(os.path.join(node_dir, "config", "priv_validator.json"))
         )
+        nk = NodeKey(PrivKeyEd25519.generate())
+        nk.save_as(os.path.join(node_dir, "config", "node_key.json"))
+        node_keys.append(nk)
     doc = GenesisDoc(
         chain_id=args.chain_id or f"chain-{int(time.time())}",
         genesis_time_ns=time.time_ns(),
@@ -200,9 +215,16 @@ def cmd_testnet(args) -> int:
         ],
     )
     doc.validate_and_complete()
+    peers = ",".join(
+        f"{nk.id()}@127.0.0.1:{base_port + 2 * i}"
+        for i, nk in enumerate(node_keys)
+    )
     for i in range(n):
         doc.save_as(os.path.join(out, f"node{i}", "config", "genesis.json"))
+        with open(os.path.join(out, f"node{i}", "config", "peers.txt"), "w") as f:
+            f.write(peers + "\n")
     print(f"Successfully initialized {n} node directories in {out}")
+    print(f"persistent_peers: {peers}")
     return 0
 
 
@@ -220,6 +242,11 @@ def main(argv=None) -> int:
     sp.add_argument("--rpc.laddr", dest="rpc_laddr", default="tcp://127.0.0.1:26657")
     sp.add_argument("--p2p.laddr", dest="p2p_laddr", default="")
     sp.add_argument("--p2p.persistent_peers", dest="persistent_peers", default="")
+    sp.add_argument("--consensus.timeout_commit", dest="timeout_commit",
+                    type=float, default=None)
+    sp.add_argument("--fast_sync", choices=["true", "false"], default=None)
+    sp.add_argument("--p2p.allow_duplicate_ip", dest="allow_duplicate_ip",
+                    choices=["true", "false"], default=None)
     sp.add_argument("--log_level", default="info")
     sp.set_defaults(fn=cmd_node)
 
@@ -239,6 +266,7 @@ def main(argv=None) -> int:
     sp.add_argument("--v", type=int, default=4)
     sp.add_argument("--output-dir", default="./mytestnet")
     sp.add_argument("--chain-id", default="")
+    sp.add_argument("--starting-port", dest="starting_port", type=int, default=26656)
     sp.set_defaults(fn=cmd_testnet)
 
     args = p.parse_args(argv)

@@ -377,6 +377,13 @@ class ConsensusReactor(Reactor):
         with self._ps_mtx:
             return self._peer_states.get(peer_id)
 
+    def peer_height(self, peer_id: str) -> Optional[int]:
+        """The peer's consensus height — the hold-back signal the mempool and
+        evidence gossip reactors consume (reference: PeerState.GetHeight via
+        the peer's shared state key, mempool/reactor.go:150)."""
+        ps = self.peer_state(peer_id)
+        return ps.height if ps is not None else None
+
     # -- inbound -------------------------------------------------------------------
     def receive(self, chan_id: int, peer, msg_bytes: bytes) -> None:
         if not self.is_running:

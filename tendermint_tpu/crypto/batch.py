@@ -114,11 +114,22 @@ _default = None
 
 
 def get_batch_verifier(prefer_tpu: bool = True):
-    """Process-wide default verifier. TPU backend if jax is importable."""
+    """Process-wide default verifier. TPU backend if jax is importable.
+
+    TM_BATCH_VERIFIER=host|xla|pallas overrides (deployment knob: small
+    localnet validators with tiny commits want the host oracle — a tunneled
+    device round-trip per 4-signature commit is pure loss)."""
     global _default
     with _lock:
         if _default is None:
-            if prefer_tpu:
+            import os
+
+            forced = os.environ.get("TM_BATCH_VERIFIER", "").lower()
+            if forced == "host":
+                _default = HostBatchVerifier()
+            elif forced in ("xla", "pallas"):
+                _default = TPUBatchVerifier(backend=forced)
+            elif prefer_tpu:
                 try:
                     _default = TPUBatchVerifier()
                 except Exception:

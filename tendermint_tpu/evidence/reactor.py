@@ -46,11 +46,12 @@ def decode_evidence_list(data: bytes) -> List[DuplicateVoteEvidence]:
 
 
 class EvidenceReactor(Reactor):
-    def __init__(self, evpool: EvidencePool):
+    def __init__(self, evpool: EvidencePool, peer_height_lookup=None):
+        """peer_height_lookup(peer_id) -> Optional[int]: the peer's consensus
+        height (normally ConsensusReactor.peer_height, wired by the node)."""
         super().__init__(name="EvidenceReactor")
         self.evpool = evpool
-        self._peer_height_fn = {}
-        self._ph_mtx = threading.Lock()
+        self._peer_height_lookup = peer_height_lookup
 
     def get_channels(self):
         return [
@@ -60,18 +61,11 @@ class EvidenceReactor(Reactor):
             )
         ]
 
-    def set_peer_height_fn(self, peer_id: str, fn) -> None:
-        """Wire the consensus reactor's PeerState height (node composition)."""
-        with self._ph_mtx:
-            self._peer_height_fn[peer_id] = fn
-
     def _peer_height(self, peer_id: str) -> Optional[int]:
-        with self._ph_mtx:
-            fn = self._peer_height_fn.get(peer_id)
-        if fn is None:
+        if self._peer_height_lookup is None:
             return None
         try:
-            return fn()
+            return self._peer_height_lookup(peer_id)
         except Exception:
             return None
 
@@ -82,10 +76,6 @@ class EvidenceReactor(Reactor):
             name=f"evidence-gossip-{peer.id[:8]}",
             daemon=True,
         ).start()
-
-    def remove_peer(self, peer, reason) -> None:
-        with self._ph_mtx:
-            self._peer_height_fn.pop(peer.id, None)
 
     def receive(self, chan_id: int, peer, msg_bytes: bytes) -> None:
         if len(msg_bytes) > MAX_MSG_SIZE:

@@ -45,12 +45,17 @@ def setup(
 ) -> Logger:
     """Configure root 'tm' logger; module_levels maps e.g. {'consensus':'debug'}
     (the reference's log_level 'consensus:debug,*:error' filter syntax)."""
-    root = logging.getLogger("tm")
+    # configure the real root: services log under many top-level names
+    # ("tm.*", "Switch", "consensus.State", "MConn-..."); attaching only to
+    # "tm" would silently drop every p2p/consensus service log
+    root = logging.getLogger()
     root.setLevel(getattr(logging, level.upper()))
     if not root.handlers:
         h = logging.StreamHandler(stream or sys.stderr)
         h.setFormatter(logging.Formatter(_FORMAT))
         root.addHandler(h)
+    for noisy in ("jax", "jax._src"):  # jax debug spam at tm debug levels
+        logging.getLogger(noisy).setLevel(logging.WARNING)
     for mod, lvl in (module_levels or {}).items():
         logging.getLogger(f"tm.{mod}").setLevel(getattr(logging, lvl.upper()))
     return Logger()

@@ -1,0 +1,248 @@
+"""Minimal Prometheus-style metrics: Counter/Gauge/Histogram + registry +
+text exposition (ref: the go-kit prometheus metrics used at
+consensus/metrics.go:14, p2p/metrics.go, mempool/metrics.go,
+state/metrics.go, served at node/node.go:698).
+
+No external client library — exposition format is plain text v0.0.4, which
+is all Prometheus needs to scrape.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+
+def _fmt_value(v: float) -> str:
+    """Full precision: %g truncates to 6 significant digits, silently
+    corrupting counters past ~1e6 (real client libs emit repr-style)."""
+    if v == int(v) and abs(v) < 1e15:
+        return str(int(v))
+    return repr(v)
+
+
+def _fmt_labels(names: Sequence[str], values: Tuple[str, ...]) -> str:
+    if not names:
+        return ""
+    inner = ",".join(f'{n}="{v}"' for n, v in zip(names, values))
+    return "{" + inner + "}"
+
+
+class _Metric:
+    kind = "untyped"
+
+    def __init__(self, name: str, help: str, label_names: Sequence[str] = ()):
+        self.name = name
+        self.help = help
+        self.label_names = tuple(label_names)
+        self._mtx = threading.Lock()
+
+    def expose(self) -> List[str]:
+        raise NotImplementedError
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    def __init__(self, name, help="", label_names=()):
+        super().__init__(name, help, label_names)
+        self._values: Dict[Tuple[str, ...], float] = {}
+
+    def labels(self, *values: str) -> "_BoundCounter":
+        return _BoundCounter(self, tuple(str(v) for v in values))
+
+    def add(self, v: float = 1.0, _labels: Tuple[str, ...] = ()) -> None:
+        with self._mtx:
+            self._values[_labels] = self._values.get(_labels, 0.0) + v
+
+    def expose(self) -> List[str]:
+        with self._mtx:
+            items = sorted(self._values.items())
+        if not items and not self.label_names:
+            return [f"{self.name} 0"]
+        return [
+            f"{self.name}{_fmt_labels(self.label_names, lv)} {_fmt_value(v)}"
+            for lv, v in items
+        ]
+
+
+class _BoundCounter:
+    def __init__(self, parent: Counter, labels: Tuple[str, ...]):
+        self._p, self._l = parent, labels
+
+    def add(self, v: float = 1.0) -> None:
+        self._p.add(v, self._l)
+
+
+class Gauge(_Metric):
+    kind = "gauge"
+
+    def __init__(self, name, help="", label_names=()):
+        super().__init__(name, help, label_names)
+        self._values: Dict[Tuple[str, ...], float] = {} if label_names else {(): 0.0}
+
+    def labels(self, *values: str) -> "_BoundGauge":
+        return _BoundGauge(self, tuple(str(v) for v in values))
+
+    def set(self, v: float, _labels: Tuple[str, ...] = ()) -> None:
+        with self._mtx:
+            self._values[_labels] = float(v)
+
+    def add(self, v: float = 1.0, _labels: Tuple[str, ...] = ()) -> None:
+        with self._mtx:
+            self._values[_labels] = self._values.get(_labels, 0.0) + v
+
+    def expose(self) -> List[str]:
+        with self._mtx:
+            items = sorted(self._values.items())
+        return [
+            f"{self.name}{_fmt_labels(self.label_names, lv)} {_fmt_value(v)}"
+            for lv, v in items
+        ]
+
+
+class _BoundGauge:
+    def __init__(self, parent: Gauge, labels: Tuple[str, ...]):
+        self._p, self._l = parent, labels
+
+    def set(self, v: float) -> None:
+        self._p.set(v, self._l)
+
+    def add(self, v: float = 1.0) -> None:
+        self._p.add(v, self._l)
+
+
+_DEFAULT_BUCKETS = (
+    0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0
+)
+
+
+class Histogram(_Metric):
+    kind = "histogram"
+
+    def __init__(self, name, help="", buckets: Sequence[float] = _DEFAULT_BUCKETS):
+        super().__init__(name, help)
+        self.buckets = tuple(sorted(buckets))
+        self._counts = [0] * (len(self.buckets) + 1)  # +Inf
+        self._sum = 0.0
+        self._count = 0
+
+    def observe(self, v: float) -> None:
+        with self._mtx:
+            self._sum += v
+            self._count += 1
+            for i, b in enumerate(self.buckets):
+                if v <= b:
+                    self._counts[i] += 1
+                    return
+            self._counts[-1] += 1
+
+    def expose(self) -> List[str]:
+        with self._mtx:
+            counts, total, s = list(self._counts), self._count, self._sum
+        out, cum = [], 0
+        for b, c in zip(self.buckets, counts):
+            cum += c
+            out.append(f'{self.name}_bucket{{le="{b:g}"}} {cum}')
+        out.append(f'{self.name}_bucket{{le="+Inf"}} {total}')
+        out.append(f"{self.name}_sum {_fmt_value(s)}")
+        out.append(f"{self.name}_count {total}")
+        return out
+
+
+class Registry:
+    def __init__(self, namespace: str = "tendermint"):
+        self.namespace = namespace
+        self._metrics: List[_Metric] = []
+        self._mtx = threading.Lock()
+
+    def _register(self, m: _Metric) -> _Metric:
+        with self._mtx:
+            self._metrics.append(m)
+        return m
+
+    def counter(self, name, help="", label_names=()) -> Counter:
+        return self._register(Counter(f"{self.namespace}_{name}", help, label_names))
+
+    def gauge(self, name, help="", label_names=()) -> Gauge:
+        return self._register(Gauge(f"{self.namespace}_{name}", help, label_names))
+
+    def histogram(self, name, help="", buckets=_DEFAULT_BUCKETS) -> Histogram:
+        return self._register(Histogram(f"{self.namespace}_{name}", help, buckets))
+
+    def expose_text(self) -> str:
+        lines: List[str] = []
+        with self._mtx:
+            metrics = list(self._metrics)
+        for m in metrics:
+            if m.help:
+                lines.append(f"# HELP {m.name} {m.help}")
+            lines.append(f"# TYPE {m.name} {m.kind}")
+            lines.extend(m.expose())
+        return "\n".join(lines) + "\n"
+
+
+# -- the per-subsystem metric sets the reference defines -----------------------
+
+
+class NodeMetrics:
+    """All four reference metric families on one registry
+    (consensus/metrics.go:14, p2p/metrics.go, mempool/metrics.go,
+    state/metrics.go)."""
+
+    def __init__(self, registry: Optional[Registry] = None):
+        r = registry or Registry()
+        self.registry = r
+        # consensus
+        self.height = r.gauge("consensus_height", "Height of the chain")
+        self.rounds = r.gauge("consensus_rounds", "Round of the current height")
+        self.validators = r.gauge("consensus_validators", "Number of validators")
+        self.validators_power = r.gauge(
+            "consensus_validators_power", "Total voting power of validators"
+        )
+        self.missing_validators = r.gauge(
+            "consensus_missing_validators", "Validators missing from the last commit"
+        )
+        self.byzantine_validators = r.gauge(
+            "consensus_byzantine_validators", "Validators that double-signed"
+        )
+        self.block_interval_seconds = r.histogram(
+            "consensus_block_interval_seconds", "Time between this and the last block"
+        )
+        self.num_txs = r.gauge("consensus_num_txs", "Txs in the latest block")
+        self.block_size_bytes = r.gauge(
+            "consensus_block_size_bytes", "Size of the latest block"
+        )
+        self.total_txs = r.gauge("consensus_total_txs", "Total txs committed")
+        self.fast_syncing = r.gauge("consensus_fast_syncing", "1 while fast syncing")
+        # p2p
+        self.peers = r.gauge("p2p_peers", "Connected peers")
+        # mempool
+        self.mempool_size = r.gauge("mempool_size", "Unconfirmed txs in the mempool")
+        # state
+        self.block_processing_time = r.histogram(
+            "state_block_processing_time", "ApplyBlock seconds",
+            buckets=[b / 10 for b in _DEFAULT_BUCKETS],
+        )
+        self._last_block_time: Optional[float] = None
+
+    # called from the consensus event path -------------------------------------
+    def record_block(self, block, valset) -> None:
+        now = time.monotonic()
+        self.height.set(block.height)
+        self.num_txs.set(len(block.data.txs))
+        self.total_txs.add(len(block.data.txs))
+        self.block_size_bytes.set(len(block.marshal()))
+        if valset is not None:
+            self.validators.set(valset.size)
+            self.validators_power.set(valset.total_voting_power())
+            missing = sum(1 for pc in block.last_commit.precommits if pc is None)
+            if block.height > 1:
+                self.missing_validators.set(missing)
+        # double-sign evidence included in this block (metrics.go
+        # ByzantineValidators is computed from block evidence)
+        self.byzantine_validators.set(len(block.evidence.evidence))
+        if self._last_block_time is not None:
+            self.block_interval_seconds.observe(now - self._last_block_time)
+        self._last_block_time = now

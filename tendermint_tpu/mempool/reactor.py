@@ -40,14 +40,14 @@ def decode_tx_msg(data: bytes) -> bytes:
 
 
 class MempoolReactor(Reactor):
-    def __init__(self, mempool: Mempool, config=None):
+    def __init__(self, mempool: Mempool, peer_height_lookup=None, config=None):
+        """peer_height_lookup(peer_id) -> Optional[int]: the peer's consensus
+        height, normally ConsensusReactor.peer_height (wired by the node /
+        harness); None = assume caught up."""
         super().__init__(name="MempoolReactor")
         self.mempool = mempool
         self.config = config
-        # peer_id -> height getter (set via consensus reactor's PeerState when
-        # available; None = assume caught up)
-        self._peer_height_fn = {}
-        self._ph_mtx = threading.Lock()
+        self._peer_height_lookup = peer_height_lookup
 
     def get_channels(self):
         return [
@@ -57,34 +57,25 @@ class MempoolReactor(Reactor):
             )
         ]
 
-    def set_peer_height_fn(self, peer_id: str, fn) -> None:
-        """Wire the consensus reactor's PeerState height (node composition);
-        gossip then holds txs until the peer catches up."""
-        with self._ph_mtx:
-            self._peer_height_fn[peer_id] = fn
-
     def _peer_height(self, peer_id: str) -> Optional[int]:
-        with self._ph_mtx:
-            fn = self._peer_height_fn.get(peer_id)
-        if fn is None:
+        if self._peer_height_lookup is None:
             return None
         try:
-            return fn()
+            return self._peer_height_lookup(peer_id)
         except Exception:
             return None
 
     def add_peer(self, peer) -> None:
+        if self.config is not None and not self.config.broadcast:
+            return  # tx gossip disabled (reactor.go gates on config.Broadcast)
         threading.Thread(
             target=self._broadcast_tx_routine,
             args=(peer,),
             name=f"mempool-gossip-{peer.id[:8]}",
             daemon=True,
         ).start()
-
-    def remove_peer(self, peer, reason) -> None:
-        with self._ph_mtx:
-            self._peer_height_fn.pop(peer.id, None)
-        # the broadcast thread exits on peer.is_running
+    # remove_peer: nothing to clean — the broadcast thread exits on
+    # peer.is_running
 
     def receive(self, chan_id: int, peer, msg_bytes: bytes) -> None:
         if len(msg_bytes) > MAX_MSG_SIZE:

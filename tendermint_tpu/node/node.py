@@ -96,6 +96,13 @@ class Node(BaseService):
             self.tx_indexer = NullTxIndexer()
         self.indexer_service = TxIndexerService(self.tx_indexer, self.event_bus)
 
+        # metrics (consensus/p2p/mempool/state families; node.go:100-113
+        # MetricsProvider + the Prometheus server at node.go:698 — here the
+        # registry is scraped at the RPC server's /metrics route)
+        from tendermint_tpu.libs.metrics import NodeMetrics
+
+        self.metrics = NodeMetrics() if config.instrumentation.prometheus else None
+
         # mempool + evidence
         self.mempool = Mempool(
             self.proxy_app.mempool,
@@ -103,6 +110,7 @@ class Node(BaseService):
             size=config.mempool.size,
             cache_size=config.mempool.cache_size,
             recheck=config.mempool.recheck,
+            metrics=self.metrics,
         )
         if config.consensus.wait_for_txs():
             self.mempool.enable_txs_available()
@@ -115,8 +123,13 @@ class Node(BaseService):
             self.mempool,
             self.evidence_pool,
             self.event_bus,
+            metrics=self.metrics,
         )
-        wal_file = config.consensus.wal_file(root) if root else None
+        wal_file = (
+            config.consensus.wal_file(root)
+            if root and config.consensus.wal_path
+            else None
+        )
         wal = WAL(wal_file) if wal_file else None
         self.consensus_state = ConsensusState(
             config.consensus,
@@ -131,13 +144,125 @@ class Node(BaseService):
         if priv_validator is not None:
             self.consensus_state.set_priv_validator(priv_validator)
 
+        # p2p: transport + switch + reactors (node.go:372-471). Disabled
+        # (single-node) when p2p.laddr is empty — node.go:246-252's
+        # fastSync=false single-val path.
+        self.switch = None
+        self.consensus_reactor = None
+        self.blockchain_reactor = None
+        if config.p2p.laddr:
+            self._build_p2p(config, state)
+
         self.rpc_server = None
         self._rpc_env = None
+
+    def _build_p2p(self, config: Config, state) -> None:
+        from tendermint_tpu.blockchain.reactor import BlockchainReactor
+        from tendermint_tpu.consensus.reactor import ConsensusReactor
+        from tendermint_tpu.evidence.reactor import EvidenceReactor
+        from tendermint_tpu.mempool.reactor import MempoolReactor
+        from tendermint_tpu.p2p import (
+            MConnConfig,
+            MultiplexTransport,
+            NodeInfo,
+            NodeKey,
+            ProtocolVersion,
+            Switch,
+            SwitchConfig,
+        )
+
+        self.node_key = NodeKey.load_or_generate(config.base.node_key_path())
+        fast_sync = config.base.fast_sync
+        self.consensus_reactor = ConsensusReactor(
+            self.consensus_state, fast_sync=fast_sync
+        )
+        self.blockchain_reactor = BlockchainReactor(
+            state.copy(),
+            self.block_exec,
+            self.block_store,
+            fast_sync=fast_sync,
+            consensus_reactor=self.consensus_reactor,
+        )
+        mem_reactor = MempoolReactor(
+            self.mempool,
+            peer_height_lookup=self.consensus_reactor.peer_height,
+            config=config.mempool,
+        )
+        ev_reactor = EvidenceReactor(
+            self.evidence_pool,
+            peer_height_lookup=self.consensus_reactor.peer_height,
+        )
+
+        mconfig = MConnConfig(
+            send_rate=config.p2p.send_rate,
+            recv_rate=config.p2p.recv_rate,
+            max_packet_msg_payload_size=config.p2p.max_packet_msg_payload_size,
+            flush_throttle=config.p2p.flush_throttle_timeout,
+        )
+        # NodeInfo advertises every reactor channel (makeNodeInfo node.go:785)
+        channels = bytes(
+            d.id
+            for reactor in (
+                self.consensus_reactor, self.blockchain_reactor, mem_reactor,
+                ev_reactor,
+            )
+            for d in reactor.get_channels()
+        )
+        laddr = config.p2p.laddr
+        listen_hp = laddr[len("tcp://"):] if laddr.startswith("tcp://") else laddr
+        node_info = NodeInfo(
+            protocol_version=ProtocolVersion(),
+            id=self.node_key.id(),
+            listen_addr=listen_hp,
+            network=self.genesis_doc.chain_id,
+            version="tpu-0.1.0",
+            channels=channels,
+            moniker=config.base.moniker,
+        )
+        transport = MultiplexTransport(node_info, self.node_key)
+        self.switch = Switch(
+            transport,
+            SwitchConfig(
+                max_num_inbound_peers=config.p2p.max_num_inbound_peers,
+                max_num_outbound_peers=config.p2p.max_num_outbound_peers,
+                allow_duplicate_ip=config.p2p.allow_duplicate_ip,
+            ),
+            mconfig,
+        )
+        self.switch.add_reactor("consensus", self.consensus_reactor)
+        self.switch.add_reactor("blockchain", self.blockchain_reactor)
+        self.switch.add_reactor("mempool", mem_reactor)
+        self.switch.add_reactor("evidence", ev_reactor)
 
     # lifecycle -------------------------------------------------------------
     def on_start(self) -> None:
         self.event_bus.start()
         self.indexer_service.start()
+        if self.metrics is not None:
+            from tendermint_tpu.types.events import EVENT_NEW_BLOCK, query_for_event
+
+            sub = self.event_bus.subscribe(
+                "node-metrics", query_for_event(EVENT_NEW_BLOCK), maxsize=100
+            )
+
+            def _pump():
+                import queue as _q
+
+                while self.is_running or not self._quit.is_set():
+                    try:
+                        msg = sub.get(timeout=0.2)
+                    except _q.Empty:
+                        if self._quit.is_set():
+                            return
+                        continue
+                    try:
+                        rs = self.consensus_state.get_round_state()
+                        self.metrics.record_block(msg.data.block, rs.validators)
+                        self.metrics.rounds.set(rs.round)
+                    except Exception:
+                        pass
+
+            threading.Thread(target=_pump, name="metrics-pump", daemon=True).start()
         if self.config.rpc.laddr:
             from tendermint_tpu.rpc.server import RPCServer
             from tendermint_tpu.rpc.core.env import RPCEnv
@@ -145,12 +270,52 @@ class Node(BaseService):
             self._rpc_env = RPCEnv(self)
             self.rpc_server = RPCServer(self.config.rpc.laddr, self._rpc_env)
             self.rpc_server.start()
-        self.consensus_state.start()
+        if self.switch is not None:
+            # the consensus reactor starts (or fast-sync defers) the
+            # consensus state; dial persistent peers after listening
+            laddr = self.config.p2p.laddr
+            self.switch.transport.listen(
+                laddr[len("tcp://"):] if laddr.startswith("tcp://") else laddr
+            )
+            self.switch.start()
+            if self.config.p2p.persistent_peers:
+                from tendermint_tpu.p2p import NetAddress
+
+                addrs = [
+                    NetAddress.parse(a)
+                    for a in self.config.p2p.persistent_peers.split(",")
+                    if a.strip()
+                ]
+                addrs = [a for a in addrs if a.id != self.node_key.id()]
+                self.switch.dial_peers_async(addrs, persistent=True)
+            if self.metrics is not None:
+                threading.Thread(
+                    target=self._p2p_metrics_pump, name="p2p-metrics", daemon=True
+                ).start()
+        else:
+            self.consensus_state.start()
         self.logger.info("node started chain_id=%s", self.genesis_doc.chain_id)
 
+    def _p2p_metrics_pump(self) -> None:
+        import time as _t
+
+        while not self._quit.is_set():
+            try:
+                self.metrics.peers.set(self.switch.peers.size())
+                if self.blockchain_reactor is not None:
+                    self.metrics.fast_syncing.set(
+                        1 if self.blockchain_reactor.fast_sync else 0
+                    )
+            except Exception:
+                pass
+            _t.sleep(1.0)
+
     def on_stop(self) -> None:
-        for svc in (self.consensus_state, self.rpc_server, self.indexer_service,
-                    self.event_bus, self.proxy_app):
+        # switch first: it stops its reactors, which stop the consensus state
+        services = [self.switch] if self.switch is not None else [self.consensus_state]
+        services += [self.rpc_server, self.indexer_service, self.event_bus,
+                     self.proxy_app]
+        for svc in services:
             if svc is None:
                 continue
             try:
@@ -181,7 +346,11 @@ class Node(BaseService):
                     meta.header.app_hash.hex().upper() if meta else ""
                 ),
                 "latest_block_time_ns": meta.header.time_ns if meta else 0,
-                "catching_up": False,
+                "catching_up": (
+                    self.blockchain_reactor.fast_sync
+                    if self.blockchain_reactor is not None
+                    else False
+                ),
             },
             "validator_info": {
                 "address": pub.address().hex().upper() if pub else "",

@@ -124,8 +124,14 @@ class PeerSet:
             return self._by_id.get(peer_id)
 
     def remove(self, peer: Peer) -> bool:
+        """Remove THIS peer object. Identity-checked: a stale peer's late
+        error must not evict the replacement connection that took its ID."""
         with self._mtx:
-            return self._by_id.pop(peer.id, None) is not None
+            cur = self._by_id.get(peer.id)
+            if cur is not peer:
+                return False
+            del self._by_id[peer.id]
+            return True
 
     def list(self) -> List[Peer]:
         with self._mtx:

@@ -36,8 +36,11 @@ from tendermint_tpu.p2p.netaddress import NetAddress
 from tendermint_tpu.p2p.peer import Peer, PeerSet
 from tendermint_tpu.p2p.transport import MultiplexTransport, UpgradedConn
 
-RECONNECT_ATTEMPTS = 20  # reconnectAttempts before giving up (switch.go:32)
 RECONNECT_BASE_WAIT = 0.1  # shrunk from the reference's 5s for testability
+RECONNECT_MAX_WAIT = 2.0  # backoff cap: a dead link must heal in seconds
+# capped-wait attempts sized for a ~10 min retry horizon (the reference's
+# 20 exponential + 10 slow attempts span comparable wall time)
+RECONNECT_ATTEMPTS = 300
 
 
 class SwitchConfig:
@@ -197,7 +200,8 @@ class Switch(BaseService):
                 for attempt in range(RECONNECT_ATTEMPTS):
                     if self._quit.is_set() or self.peers.has(addr.id):
                         return
-                    time.sleep(base * (1.5**attempt) + random.random() * base)
+                    wait = min(RECONNECT_MAX_WAIT, base * (1.5**attempt))
+                    time.sleep(wait + random.random() * base)
                     try:
                         self.dial_peer_with_address(addr, persistent=True)
                         return
@@ -215,13 +219,32 @@ class Switch(BaseService):
         threading.Thread(target=_loop, name="switch-reconnect", daemon=True).start()
 
     # -- peer admission -------------------------------------------------------------
+    def _conn_is_canonical(self, outbound: bool, peer_id: str) -> bool:
+        """Of two simultaneous cross-connections between the same pair, both
+        sides must agree which survives, or each keeps the one the other
+        kills and the pair flaps forever. Canon: the conn DIALED by the
+        lexicographically smaller node ID."""
+        dialer = self.node_id if outbound else peer_id
+        return dialer == min(self.node_id, peer_id)
+
     def _add_peer(self, up: UpgradedConn, persistent: bool = False) -> Peer:
         if up.node_info.id == self.node_id:
             up.conn.close()
             raise SwitchConnectToSelfError(up.socket_addr)
-        if self.peers.has(up.node_info.id):
-            up.conn.close()
-            raise SwitchDuplicatePeerIDError(up.node_info.id)
+        existing = self.peers.get(up.node_info.id)
+        if existing is not None:
+            if self._conn_is_canonical(
+                up.outbound, up.node_info.id
+            ) and not self._conn_is_canonical(existing.outbound, existing.id):
+                # the new conn is the agreed survivor: evict the old one
+                self.logger.info(
+                    "replacing non-canonical duplicate conn to %s",
+                    up.node_info.id[:8],
+                )
+                self._stop_and_remove_peer(existing, "duplicate (non-canonical)")
+            else:
+                up.conn.close()
+                raise SwitchDuplicatePeerIDError(up.node_info.id)
         if not self.config.allow_duplicate_ip and self.peers.has_ip(
             up.socket_addr.host
         ):
@@ -278,8 +301,15 @@ class Switch(BaseService):
 
     # -- removal ----------------------------------------------------------------
     def stop_peer_for_error(self, peer: Peer, reason) -> None:
-        if not self.peers.has(peer.id):
-            return  # already removed (error + explicit stop racing)
+        if self.peers.get(peer.id) is not peer:
+            # stale object (already replaced/removed): silence it without
+            # touching the set entry that superseded it
+            if peer.is_running:
+                try:
+                    peer.stop()
+                except Exception:
+                    pass
+            return
         self.logger.info("stopping peer %s: %s", peer.id[:8], reason)
         self._stop_and_remove_peer(peer, reason)
         if peer.persistent and not self._quit.is_set():
@@ -291,13 +321,14 @@ class Switch(BaseService):
         self._stop_and_remove_peer(peer, reason=None)
 
     def _stop_and_remove_peer(self, peer: Peer, reason) -> None:
-        if not self.peers.remove(peer):
-            return
+        removed = self.peers.remove(peer)  # identity-checked
         if peer.is_running:
             try:
                 peer.stop()
             except Exception:
                 pass
+        if not removed:
+            return
         for reactor in self.reactors.values():
             try:
                 reactor.remove_peer(peer, reason)

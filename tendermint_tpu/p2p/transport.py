@@ -35,8 +35,11 @@ from tendermint_tpu.p2p.key import NodeKey
 from tendermint_tpu.p2p.netaddress import NetAddress
 from tendermint_tpu.p2p.node_info import NodeInfo
 
-HANDSHAKE_TIMEOUT = 3.0  # defaultHandshakeTimeout (transport.go:26)
-DIAL_TIMEOUT = 3.0
+# the reference uses 3s (transport.go:26); under multi-process startup
+# contention (every node importing jax at once) a 3s budget flakes, and the
+# reference's own config default is 20s (config.go HandshakeTimeout)
+HANDSHAKE_TIMEOUT = 10.0
+DIAL_TIMEOUT = 10.0
 MAX_NODE_INFO_SIZE = 10 * 1024
 
 

@@ -128,8 +128,20 @@ class RPCEnv:
         sw = getattr(self.node, "switch", None)
         peers = []
         if sw is not None:
-            for p in sw.peers_list():
-                peers.append({"node_info": p.node_info_dict(), "is_outbound": p.outbound})
+            for p in sw.peers.list():
+                ni = p.node_info
+                peers.append(
+                    {
+                        "node_info": {
+                            "id": ni.id,
+                            "listen_addr": ni.listen_addr,
+                            "network": ni.network,
+                            "moniker": ni.moniker,
+                        },
+                        "is_outbound": p.outbound,
+                        "remote_ip": p.socket_addr.host if p.socket_addr else "",
+                    }
+                )
         return {"listening": sw is not None, "peers": peers, "n_peers": len(peers)}
 
     def unconfirmed_txs(self, limit: int = 30) -> dict:

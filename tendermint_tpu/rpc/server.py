@@ -88,6 +88,19 @@ class RPCServer(BaseService):
             def do_GET(self):
                 parsed = urllib.parse.urlparse(self.path)
                 method = parsed.path.strip("/")
+                if method == "websocket":
+                    return self._upgrade_websocket()
+                if method == "metrics":
+                    reg = getattr(env.node, "metrics", None)
+                    if reg is None:
+                        return self._send(_err(None, -32601, "metrics disabled"), 404)
+                    body = reg.registry.expose_text().encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/plain; version=0.0.4")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
                 if method == "":
                     # route listing, like the reference's index page
                     routes = sorted(
@@ -100,6 +113,23 @@ class RPCServer(BaseService):
                     for k, v in urllib.parse.parse_qs(parsed.query).items()
                 }
                 self._call(method, params, -1)
+
+            def _upgrade_websocket(self):
+                """RFC 6455 handshake, then hand the socket to a WSSession
+                (the reference's /websocket endpoint, ws_handler.go)."""
+                from tendermint_tpu.rpc.websocket import WSSession, accept_key
+
+                key = self.headers.get("Sec-WebSocket-Key")
+                upgrade = (self.headers.get("Upgrade") or "").lower()
+                if key is None or upgrade != "websocket":
+                    return self._send(_err(None, -32600, "not a websocket upgrade"), 400)
+                self.send_response(101, "Switching Protocols")
+                self.send_header("Upgrade", "websocket")
+                self.send_header("Connection", "Upgrade")
+                self.send_header("Sec-WebSocket-Accept", accept_key(key))
+                self.end_headers()
+                self.close_connection = True
+                WSSession(self, env.node.event_bus, logger).run()
 
         host, port = _parse_laddr(self.laddr)
         self._httpd = ThreadingHTTPServer((host, port), Handler)

@@ -190,11 +190,15 @@ def make_consensus_net(
         if with_mempool_reactor:
             from tendermint_tpu.mempool.reactor import MempoolReactor
 
-            node.mempool_reactor = MempoolReactor(cs.mempool)
+            node.mempool_reactor = MempoolReactor(
+                cs.mempool, peer_height_lookup=reactor.peer_height
+            )
         if with_evidence_reactor:
             from tendermint_tpu.evidence.reactor import EvidenceReactor
 
-            node.evidence_reactor = EvidenceReactor(cs.evpool)
+            node.evidence_reactor = EvidenceReactor(
+                cs.evpool, peer_height_lookup=reactor.peer_height
+            )
         nodes.append(node)
 
     def _init(i, sw):

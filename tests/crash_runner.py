@@ -1,0 +1,100 @@
+"""Crash-recovery test runner: a durable single-validator node that commits
+until a target height, then exits 0 — killed mid-flight by either
+
+  * FAIL_TEST_INDEX=k          — die at the k-th fail_point() call
+                                 (finalize-commit/apply-block kill sites;
+                                 ref test/persist/test_failure_indices.sh);
+  * WAL_CRASH_AFTER_WRITES=n   — die right AFTER the n-th WAL write reaches
+                                 the file (ref consensus/replay_test.go:97
+                                 TestWALCrash crashingWAL).
+
+Restarting with the same home dir must recover via handshake + WAL catchup
+and keep committing. Usage: python crash_runner.py HOME TARGET_HEIGHT
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+from tendermint_tpu.crypto import batch as _batch
+
+_batch.set_batch_verifier(_batch.HostBatchVerifier())
+
+
+def main() -> int:
+    home, target = os.path.abspath(sys.argv[1]), int(sys.argv[2])
+
+    from tendermint_tpu.config.config import default_config, test_config
+    from tendermint_tpu.node.node import Node
+    from tendermint_tpu.privval.file_pv import FilePV
+    from tendermint_tpu.types import GenesisDoc, GenesisValidator
+
+    cfg = default_config()
+    cfg.set_root(home)
+    cfg.base.proxy_app = "kvstore"
+    cfg.rpc.laddr = ""  # no RPC needed
+    cfg.p2p.laddr = ""  # single-node: no p2p
+    cfg.consensus = test_config().consensus  # fast timeouts
+    cfg.consensus.wal_path = "data/cs.wal/wal"
+
+    os.makedirs(os.path.join(home, "config"), exist_ok=True)
+    os.makedirs(os.path.join(home, "data"), exist_ok=True)
+    pv = FilePV.load_or_generate(cfg.base.priv_validator_path())
+    genesis_path = cfg.base.genesis_path()
+    if not os.path.exists(genesis_path):
+        doc = GenesisDoc(
+            chain_id="crash-chain",
+            genesis_time_ns=1_700_000_000_000_000_000,
+            validators=[GenesisValidator(pv.get_pub_key(), 10, "")],
+        )
+        doc.validate_and_complete()
+        doc.save_as(genesis_path)
+
+    # WAL crash mode: count writes at the autofile boundary so both write()
+    # and write_sync() register, then die abruptly
+    crash_after = os.environ.get("WAL_CRASH_AFTER_WRITES")
+    if crash_after is not None:
+        threshold = int(crash_after)
+        from tendermint_tpu.consensus import wal as wal_mod
+
+        orig_write = wal_mod.WAL.write
+        state = {"n": 0}
+
+        def counting_write(self, msg):
+            orig_write(self, msg)
+            state["n"] += 1
+            if state["n"] >= threshold:
+                sys.stderr.write(f"WAL crash after {state['n']} writes\n")
+                sys.stderr.flush()
+                os._exit(1)
+
+        wal_mod.WAL.write = counting_write
+
+    node = Node(cfg, priv_validator=pv)
+    node.start()
+    deadline = time.monotonic() + 120
+    try:
+        while time.monotonic() < deadline:
+            h = node.block_store.height()
+            if h >= target:
+                meta = node.block_store.load_block_meta(h)
+                print(f"DONE height={h} apphash={meta.header.app_hash.hex()}", flush=True)
+                return 0
+            time.sleep(0.02)
+        print(f"TIMEOUT height={node.block_store.height()}", flush=True)
+        return 2
+    finally:
+        try:
+            node.stop()
+        except Exception:
+            pass
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,142 @@
+"""Multi-process localnet through the CLI — the tier-2 substrate
+(ref: docker-compose.yml 4-node localnet + test/p2p/ scripted testnets):
+`testnet` generates the config tree, N real `node` processes connect over
+real TCP p2p and commit blocks together; a killed node restarts and fast
+syncs back.
+"""
+
+import http.client
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CLI = [sys.executable, "-m", "tendermint_tpu.cmd.tendermint"]
+
+
+def _env():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["TM_BATCH_VERIFIER"] = "host"
+    return env
+
+
+def _rpc(port, path, timeout=2):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        conn.request("GET", path)
+        return json.loads(conn.getresponse().read())
+    finally:
+        conn.close()
+
+
+def _status_height(port):
+    try:
+        r = _rpc(port, "/status")
+        return int(r["result"]["sync_info"]["latest_block_height"])
+    except Exception:
+        return -1
+
+
+def _n_peers(port):
+    try:
+        return int(_rpc(port, "/net_info")["result"]["n_peers"])
+    except Exception:
+        return -1
+
+
+def _spawn_node(home, i, peers, base_port):
+    p2p = base_port + 2 * i
+    rpc = base_port + 2 * i + 1
+    proc = subprocess.Popen(
+        CLI
+        + [
+            "--home", os.path.join(home, f"node{i}"),
+            "node",
+            "--proxy_app", "kvstore",
+            "--rpc.laddr", f"tcp://127.0.0.1:{rpc}",
+            "--p2p.laddr", f"tcp://127.0.0.1:{p2p}",
+            "--p2p.persistent_peers", peers,
+            "--consensus.timeout_commit", "0.3",
+            "--p2p.allow_duplicate_ip", "true",
+            "--log_level", "error",
+        ],
+        cwd=REPO,
+        env=_env(),
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        text=True,
+    )
+    return proc, rpc
+
+
+def _wait(pred, timeout, step=0.25):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(step)
+    return False
+
+
+N = 4  # killing one leaves 3/4 > 2/3 quorum
+BASE_PORT = 28700
+
+
+class TestLocalnet:
+    def test_four_process_net_commits_and_recovers(self, tmp_path):
+        home = str(tmp_path)
+        gen = subprocess.run(
+            CLI + ["testnet", "--v", str(N), "--output-dir", home,
+                   "--chain-id", "localnet", "--starting-port", str(BASE_PORT)],
+            capture_output=True, text=True, cwd=REPO, env=_env(), timeout=60,
+        )
+        assert gen.returncode == 0, gen.stderr
+        peers = open(os.path.join(home, "node0", "config", "peers.txt")).read().strip()
+
+        procs = []
+        rpc_ports = []
+        try:
+            for i in range(N):
+                proc, rpc = _spawn_node(home, i, peers, BASE_PORT)
+                procs.append(proc)
+                rpc_ports.append(rpc)
+
+            # all nodes peer up and commit together over real TCP
+            assert _wait(
+                lambda: all(_n_peers(p) >= N - 1 for p in rpc_ports), 60
+            ), [(_n_peers(p)) for p in rpc_ports]
+            assert _wait(
+                lambda: all(_status_height(p) >= 3 for p in rpc_ports), 90
+            ), [(_status_height(p)) for p in rpc_ports]
+
+            # kill one node; the remaining 3/4 supermajority keeps committing
+            procs[3].send_signal(signal.SIGKILL)
+            procs[3].wait(10)
+            h = max(_status_height(p) for p in rpc_ports[:3])
+            assert _wait(
+                lambda: all(_status_height(p) >= h + 2 for p in rpc_ports[:3]), 60
+            )
+
+            # restart the killed node: it rejoins (fast sync) and catches up
+            proc, rpc = _spawn_node(home, 3, peers, BASE_PORT)
+            procs[3] = proc
+            rpc_ports[3] = rpc
+            target = max(_status_height(p) for p in rpc_ports[:3])
+            assert _wait(
+                lambda: _status_height(rpc_ports[3]) >= target, 90
+            ), (_status_height(rpc_ports[3]), target)
+        finally:
+            for proc in procs:
+                if proc.poll() is None:
+                    proc.send_signal(signal.SIGTERM)
+            for proc in procs:
+                try:
+                    proc.wait(10)
+                except subprocess.TimeoutExpired:
+                    proc.kill()

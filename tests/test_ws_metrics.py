@@ -1,0 +1,211 @@
+"""WebSocket event subscription + Prometheus metrics over a real node
+(ref: rpc/lib/server/ws_handler_test.go, the subscribe route at
+rpc/core/routes.go:11, metrics at node/node.go:698).
+"""
+
+import base64
+import http.client
+import json
+import os
+import socket
+import struct
+import threading
+import time
+
+import pytest
+
+from tendermint_tpu.rpc.websocket import OP_TEXT, read_message
+
+from tests.consensus_harness import wait_for
+
+
+# -- a minimal masked-frame WS client ----------------------------------------------
+
+
+class WSClient:
+    def __init__(self, host, port, path="/websocket"):
+        self.sock = socket.create_connection((host, port), timeout=10)
+        key = base64.b64encode(os.urandom(16)).decode()
+        req = (
+            f"GET {path} HTTP/1.1\r\nHost: {host}:{port}\r\n"
+            "Upgrade: websocket\r\nConnection: Upgrade\r\n"
+            f"Sec-WebSocket-Key: {key}\r\nSec-WebSocket-Version: 13\r\n\r\n"
+        )
+        self.sock.sendall(req.encode())
+        self.rfile = self.sock.makefile("rb")
+        status = self.rfile.readline()
+        assert b"101" in status, status
+        while self.rfile.readline() not in (b"\r\n", b""):
+            pass
+
+    def send_json(self, obj) -> None:
+        payload = json.dumps(obj).encode()
+        mask = os.urandom(4)
+        head = bytes([0x80 | OP_TEXT])
+        n = len(payload)
+        if n < 126:
+            head += bytes([0x80 | n])
+        else:
+            head += bytes([0x80 | 126]) + struct.pack(">H", n)
+        masked = bytes(b ^ mask[i % 4] for i, b in enumerate(payload))
+        self.sock.sendall(head + mask + masked)
+
+    def recv_json(self, timeout=15):
+        self.sock.settimeout(timeout)
+        msg = read_message(self.rfile)
+        assert msg is not None, "connection closed"
+        opcode, payload = msg
+        assert opcode == OP_TEXT, opcode
+        return json.loads(payload)
+
+    def close(self):
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+# -- node fixture ------------------------------------------------------------------
+
+
+@pytest.fixture()
+def live_node(tmp_path):
+    from tendermint_tpu.config.config import default_config, test_config
+    from tendermint_tpu.node.node import Node
+    from tendermint_tpu.privval.file_pv import FilePV
+    from tendermint_tpu.types import GenesisDoc, GenesisValidator
+
+    home = str(tmp_path / "node")
+    cfg = default_config()
+    cfg.set_root(home)
+    cfg.base.proxy_app = "kvstore"
+    cfg.base.db_backend = "memdb"
+    cfg.rpc.laddr = "tcp://127.0.0.1:0"
+    cfg.p2p.laddr = ""
+    cfg.consensus = test_config().consensus
+    cfg.consensus.wal_path = ""
+    cfg.instrumentation.prometheus = True
+    os.makedirs(os.path.join(home, "config"), exist_ok=True)
+    pv = FilePV.generate(os.path.join(home, "config", "pv.json"))
+    doc = GenesisDoc(
+        chain_id="ws-chain",
+        genesis_time_ns=1_700_000_000_000_000_000,
+        validators=[GenesisValidator(pv.get_pub_key(), 10)],
+    )
+    doc.validate_and_complete()
+    node = Node(cfg, priv_validator=pv, genesis_doc=doc)
+    node.start()
+    try:
+        assert wait_for(lambda: node.block_store.height() >= 1, timeout=30)
+        yield node
+    finally:
+        node.stop()
+
+
+def _rpc_get(node, path):
+    conn = http.client.HTTPConnection("127.0.0.1", node.rpc_server.bound_port, timeout=10)
+    conn.request("GET", path)
+    resp = conn.getresponse()
+    body = resp.read()
+    conn.close()
+    return resp.status, body
+
+
+class TestWebSocketSubscribe:
+    def test_subscribe_new_block_events(self, live_node):
+        ws = WSClient("127.0.0.1", live_node.rpc_server.bound_port)
+        try:
+            ws.send_json(
+                {"jsonrpc": "2.0", "id": 7, "method": "subscribe",
+                 "params": {"query": "tm.event = 'NewBlock'"}}
+            )
+            ack = ws.recv_json()
+            assert ack["id"] == 7 and "error" not in ack
+            ev = ws.recv_json()
+            assert ev["id"] == "7#event"
+            data = ev["result"]["data"]
+            assert data["type"] == "NewBlock"
+            assert data["value"]["block"]["header"]["height"] >= 1
+        finally:
+            ws.close()
+
+    def test_subscribe_tx_event_on_broadcast(self, live_node):
+        ws = WSClient("127.0.0.1", live_node.rpc_server.bound_port)
+        try:
+            ws.send_json(
+                {"jsonrpc": "2.0", "id": 1, "method": "subscribe",
+                 "params": {"query": "tm.event = 'Tx'"}}
+            )
+            assert "error" not in ws.recv_json()
+            tx = b"ws-key=ws-val"
+            live_node.mempool.check_tx(tx)
+            ev = ws.recv_json(timeout=30)
+            assert ev["result"]["data"]["type"] == "Tx"
+            got_tx = base64.b64decode(ev["result"]["data"]["value"]["TxResult"]["tx"])
+            assert got_tx == tx
+        finally:
+            ws.close()
+
+    def test_unsubscribe_stops_events(self, live_node):
+        ws = WSClient("127.0.0.1", live_node.rpc_server.bound_port)
+        try:
+            ws.send_json(
+                {"jsonrpc": "2.0", "id": 2, "method": "subscribe",
+                 "params": {"query": "tm.event = 'NewBlock'"}}
+            )
+            assert "error" not in ws.recv_json()
+            ws.recv_json()  # at least one event flows
+            ws.send_json(
+                {"jsonrpc": "2.0", "id": 3, "method": "unsubscribe",
+                 "params": {"query": "tm.event = 'NewBlock'"}}
+            )
+            # drain until the unsubscribe ack (events may be in flight)
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                msg = ws.recv_json()
+                if msg.get("id") == 3:
+                    break
+            else:
+                pytest.fail("no unsubscribe ack")
+            # after the ack: no further events
+            with pytest.raises(Exception):
+                ws.recv_json(timeout=1.0)
+        finally:
+            ws.close()
+
+    def test_bad_query_returns_error(self, live_node):
+        ws = WSClient("127.0.0.1", live_node.rpc_server.bound_port)
+        try:
+            ws.send_json(
+                {"jsonrpc": "2.0", "id": 4, "method": "nope", "params": {}}
+            )
+            assert ws.recv_json()["error"]["code"] == -32601
+        finally:
+            ws.close()
+
+
+class TestPrometheusMetrics:
+    def test_metrics_scrape(self, live_node):
+        assert wait_for(lambda: live_node.block_store.height() >= 2, timeout=30)
+        # let the metrics pump observe at least one block
+        assert wait_for(
+            lambda: b"tendermint_consensus_height" in _rpc_get(live_node, "/metrics")[1],
+            timeout=15,
+        )
+        status, body = _rpc_get(live_node, "/metrics")
+        assert status == 200
+        text = body.decode()
+        for needle in (
+            "# TYPE tendermint_consensus_height gauge",
+            "tendermint_consensus_validators 1",
+            "tendermint_mempool_size",
+            "tendermint_state_block_processing_time_count",
+            "tendermint_consensus_block_interval_seconds_bucket",
+        ):
+            assert needle in text, f"missing {needle}\n{text[:1500]}"
+        # height gauge tracks the chain
+        height_line = next(
+            l for l in text.splitlines()
+            if l.startswith("tendermint_consensus_height ")
+        )
+        assert float(height_line.split()[-1]) >= 1
