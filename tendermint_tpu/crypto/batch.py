@@ -50,6 +50,17 @@ class HostBatchVerifier:
             [_ed.verify(it.pubkey, it.msg, it.sig) for it in items], dtype=bool
         )
 
+    def verify_secp256k1(self, items: Sequence[SigItem]) -> np.ndarray:
+        """items carry (33B compressed pubkey, RAW msg, DER sig); the SHA-256
+        premix (secp256k1.go:140) happens here."""
+        from tendermint_tpu.crypto import secp256k1 as _secp
+        from tendermint_tpu.crypto.hashing import sha256
+
+        return np.array(
+            [_secp.verify(it.pubkey, sha256(it.msg), it.sig) for it in items],
+            dtype=bool,
+        )
+
 
 def _find_tpu_device():
     """The real chip, if reachable (even when the default backend is CPU)."""
@@ -108,6 +119,22 @@ class TPUBatchVerifier:
             ok = self._kernel.verify_batch(pubs, msgs, sigs, mesh=self._mesh)
         return np.asarray(ok, dtype=bool)
 
+    def verify_secp256k1(self, items: Sequence[SigItem]) -> np.ndarray:
+        """Batched ECDSA on device (ops/secp256k1_verify XLA kernel; the
+        pallas backend shares it — ECDSA has no pallas pipeline yet)."""
+        if len(items) == 0:
+            return np.zeros((0,), dtype=bool)
+        from tendermint_tpu.crypto.hashing import sha256
+        from tendermint_tpu.ops import secp256k1_verify as _sk
+
+        ok = _sk.verify_batch(
+            [it.pubkey for it in items],
+            [sha256(it.msg) for it in items],
+            [it.sig for it in items],
+            mesh=self._mesh,
+        )
+        return np.asarray(ok, dtype=bool)
+
 
 _lock = threading.Lock()
 _default = None
@@ -156,20 +183,33 @@ def verify_generic(
     pubkeys: Sequence[PubKey], msgs: Sequence[bytes], sigs: Sequence[bytes],
     verifier=None,
 ) -> np.ndarray:
-    """Batch-verify over PubKey objects: ed25519 keys batch to the device,
-    anything else (secp256k1, multisig) verifies on host."""
+    """Batch-verify over PubKey objects: ed25519 and secp256k1 keys batch to
+    their backends; anything else (multisig) verifies via verify_bytes."""
+    from tendermint_tpu.crypto.keys import PubKeySecp256k1
+
+    if verifier is None:
+        verifier = get_batch_verifier()
     n = len(pubkeys)
     out = np.zeros((n,), dtype=bool)
     ed_idx: List[int] = []
     ed_items: List[SigItem] = []
+    sk_idx: List[int] = []
+    sk_items: List[SigItem] = []
     for i, pk in enumerate(pubkeys):
         if isinstance(pk, PubKeyEd25519) and len(sigs[i]) == 64:
             ed_idx.append(i)
             ed_items.append(SigItem(pk.bytes(), msgs[i], sigs[i]))
+        elif isinstance(pk, PubKeySecp256k1):
+            sk_idx.append(i)
+            sk_items.append(SigItem(pk.bytes(), msgs[i], sigs[i]))
         else:
             out[i] = pk.verify_bytes(msgs[i], sigs[i])
     if ed_items:
-        res = verify_items(ed_items, verifier=verifier)
+        res = verifier.verify_ed25519(ed_items)
         for j, i in enumerate(ed_idx):
+            out[i] = res[j]
+    if sk_items:
+        res = verifier.verify_secp256k1(sk_items)
+        for j, i in enumerate(sk_idx):
             out[i] = res[j]
     return out

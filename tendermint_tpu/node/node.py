@@ -164,6 +164,7 @@ class Node(BaseService):
         from tendermint_tpu.p2p import (
             MConnConfig,
             MultiplexTransport,
+            NetAddress,
             NodeInfo,
             NodeKey,
             ProtocolVersion,
@@ -193,20 +194,40 @@ class Node(BaseService):
             peer_height_lookup=self.consensus_reactor.peer_height,
         )
 
+        pex_reactor = None
+        if config.p2p.pex:
+            from tendermint_tpu.p2p.pex import AddrBook, PEXReactor
+
+            self.addr_book = AddrBook(
+                config.p2p.addr_book_path(config.base.root_dir)
+                if config.base.root_dir
+                else None,
+                strict=config.p2p.addr_book_strict,
+            )
+            seeds = [
+                NetAddress.parse(s)
+                for s in config.p2p.seeds.split(",")
+                if s.strip()
+            ]
+            pex_reactor = PEXReactor(self.addr_book, seeds=seeds)
+
         mconfig = MConnConfig(
             send_rate=config.p2p.send_rate,
             recv_rate=config.p2p.recv_rate,
             max_packet_msg_payload_size=config.p2p.max_packet_msg_payload_size,
             flush_throttle=config.p2p.flush_throttle_timeout,
         )
-        # NodeInfo advertises every reactor channel (makeNodeInfo node.go:785)
+        # NodeInfo advertises every reactor channel incl. PEX's 0x00
+        # (makeNodeInfo node.go:785) — peers drop traffic on unadvertised
+        # channels, so an omission here silently kills that protocol
+        reactors = [
+            self.consensus_reactor, self.blockchain_reactor, mem_reactor,
+            ev_reactor,
+        ]
+        if pex_reactor is not None:
+            reactors.append(pex_reactor)
         channels = bytes(
-            d.id
-            for reactor in (
-                self.consensus_reactor, self.blockchain_reactor, mem_reactor,
-                ev_reactor,
-            )
-            for d in reactor.get_channels()
+            d.id for reactor in reactors for d in reactor.get_channels()
         )
         laddr = config.p2p.laddr
         listen_hp = laddr[len("tcp://"):] if laddr.startswith("tcp://") else laddr
@@ -233,6 +254,9 @@ class Node(BaseService):
         self.switch.add_reactor("blockchain", self.blockchain_reactor)
         self.switch.add_reactor("mempool", mem_reactor)
         self.switch.add_reactor("evidence", ev_reactor)
+        if pex_reactor is not None:
+            self.switch.addr_book = self.addr_book
+            self.switch.add_reactor("pex", pex_reactor)
 
     # lifecycle -------------------------------------------------------------
     def on_start(self) -> None:

@@ -1,0 +1,6 @@
+"""Peer exchange: address book + PEX reactor (ref: /root/reference/p2p/pex/)."""
+
+from tendermint_tpu.p2p.pex.addrbook import AddrBook, KnownAddress
+from tendermint_tpu.p2p.pex.pex_reactor import PEXReactor
+
+__all__ = ["AddrBook", "KnownAddress", "PEXReactor"]

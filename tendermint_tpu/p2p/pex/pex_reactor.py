@@ -1,0 +1,200 @@
+"""PEX reactor — peer discovery over channel 0x00
+(ref: p2p/pex/pex_reactor.go).
+
+Behaviors kept:
+
+* outbound peers get an immediate addrs request; inbound peers are only
+  recorded (we trust what WE dialed more, pex_reactor.go:166-176);
+* requests are rate-limited per peer (one per ensure-period/3); unsolicited
+  PexAddrs are a protocol violation → peer stopped (pex_reactor.go:258);
+* ``ensure_peers`` loop dials book addresses while below the outbound cap,
+  biased toward new addresses when few peers are connected
+  (pex_reactor.go ensurePeers:288-338).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+from tendermint_tpu.encoding.codec import Reader, Writer
+from tendermint_tpu.p2p.base_reactor import Reactor
+from tendermint_tpu.p2p.conn.connection import ChannelDescriptor
+from tendermint_tpu.p2p.netaddress import NetAddress
+from tendermint_tpu.p2p.pex.addrbook import AddrBook
+
+PEX_CHANNEL = 0x00
+MAX_MSG_SIZE = 64 * 1024
+ENSURE_PEERS_PERIOD = 30.0  # pex_reactor.go defaultEnsurePeersPeriod
+MAX_ADDRS_PER_MSG = 250
+
+
+def encode_pex_request() -> bytes:
+    w = Writer()
+    w.uvarint(1)
+    return w.build()
+
+
+def encode_pex_addrs(addrs: List[NetAddress]) -> bytes:
+    w = Writer()
+    w.uvarint(2).uvarint(len(addrs))
+    for a in addrs:
+        w.string(str(a))
+    return w.build()
+
+
+def decode_pex_msg(data: bytes):
+    r = Reader(data)
+    tag = r.uvarint()
+    if tag == 1:
+        return ("request", None)
+    if tag == 2:
+        n = r.uvarint()
+        if n > MAX_ADDRS_PER_MSG:
+            raise ValueError(f"too many addrs ({n})")
+        return ("addrs", [NetAddress.parse(r.string()) for _ in range(n)])
+    raise ValueError(f"unknown pex message tag {tag}")
+
+
+class PEXReactor(Reactor):
+    def __init__(
+        self,
+        book: AddrBook,
+        ensure_period: float = ENSURE_PEERS_PERIOD,
+        seeds: Optional[List[NetAddress]] = None,
+    ):
+        super().__init__(name="PEXReactor")
+        self.book = book
+        self.ensure_period = ensure_period
+        self.seeds = seeds or []
+        self._requests_sent: Dict[str, float] = {}  # peer_id -> last req time
+        # peer_id -> number of outstanding requests (a set would flag the
+        # response to our second in-flight request as unsolicited)
+        self._asked: Dict[str, int] = {}
+        self._mtx = threading.Lock()
+
+    def get_channels(self):
+        return [
+            ChannelDescriptor(
+                id=PEX_CHANNEL, priority=1, send_queue_capacity=10,
+                recv_message_capacity=MAX_MSG_SIZE,
+            )
+        ]
+
+    def on_start(self) -> None:
+        threading.Thread(
+            target=self._ensure_peers_routine, name="pex-ensure", daemon=True
+        ).start()
+
+    def on_stop(self) -> None:
+        self.book.save()
+
+    # -- peer lifecycle -----------------------------------------------------------
+    def add_peer(self, peer) -> None:
+        addr = peer.net_address()
+        if peer.outbound:
+            # we dialed it and the handshake succeeded: it's good
+            if addr is not None:
+                self.book.mark_good(addr)
+            self._request_addrs(peer)
+        else:
+            # inbound: remember where it claims to live; the ensure loop
+            # will ask it for addrs later if we're low
+            if addr is not None:
+                self.book.add_address(addr, addr)
+
+    def remove_peer(self, peer, reason) -> None:
+        with self._mtx:
+            self._requests_sent.pop(peer.id, None)
+            # the receiver-side throttle key too, or a reconnecting peer's
+            # first post-handshake request reads as a flood and gets it
+            # dropped again (connection flapping)
+            self._requests_sent.pop(f"recv:{peer.id}", None)
+            self._asked.pop(peer.id, None)
+
+    # -- messages ----------------------------------------------------------------
+    def receive(self, chan_id: int, peer, msg_bytes: bytes) -> None:
+        kind, payload = decode_pex_msg(msg_bytes)
+        if kind == "request":
+            now = time.monotonic()
+            with self._mtx:
+                last = self._requests_sent.get(f"recv:{peer.id}", 0.0)
+                if now - last < self.ensure_period / 3:
+                    raise ValueError("pex request flood")  # switch stops peer
+                self._requests_sent[f"recv:{peer.id}"] = now
+            peer.try_send(
+                PEX_CHANNEL, encode_pex_addrs(self.book.get_selection())
+            )
+        else:  # addrs
+            with self._mtx:
+                if self._asked.get(peer.id, 0) <= 0:
+                    raise ValueError("unsolicited pex addrs")
+                self._asked[peer.id] -= 1
+            src = peer.net_address() or NetAddress(peer.id, "0.0.0.0", 1)
+            for addr in payload:
+                if not self.book.is_our_address(addr):
+                    self.book.add_address(addr, src)
+
+    def _request_addrs(self, peer) -> None:
+        now = time.monotonic()
+        with self._mtx:
+            # sender-side throttle mirroring the receiver's flood limit
+            last = self._requests_sent.get(peer.id, 0.0)
+            if now - last < self.ensure_period / 3:
+                return
+            self._requests_sent[peer.id] = now
+            self._asked[peer.id] = self._asked.get(peer.id, 0) + 1
+        peer.try_send(PEX_CHANNEL, encode_pex_request())
+
+    # -- discovery loop ------------------------------------------------------------
+    def _ensure_peers_routine(self) -> None:
+        # seeds go straight into the book
+        for seed in self.seeds:
+            self.book.add_address(seed, seed)
+        while self.is_running and not self._quit.is_set():
+            try:
+                self._ensure_peers()
+            except Exception:
+                self.logger.exception("ensure_peers failed")
+            # full period between sweeps: receivers rate-limit requests at
+            # period/3, so asking any faster gets US dropped as a flooder
+            self._quit.wait(self.ensure_period)
+
+    def _ensure_peers(self) -> None:
+        sw = self.switch
+        if sw is None:
+            return
+        out = sum(1 for p in sw.peers.list() if p.outbound)
+        need = sw.config.max_num_outbound_peers - out
+        if need <= 0:
+            return
+        # few peers -> bias toward NEW addresses (explore); many -> OLD
+        bias = max(10, 70 - out * 10)
+        tried = set()
+        for _ in range(need * 3):
+            addr = self.book.pick_address(bias)
+            if addr is None:
+                break
+            if addr.id in tried:
+                continue  # random re-draw: skip, don't abort the sweep
+            tried.add(addr.id)
+            if sw.peers.has(addr.id) or addr.id == sw.node_id:
+                continue
+            self.book.mark_attempt(addr)
+
+            def _dial(a=addr):
+                try:
+                    sw.dial_peer_with_address(a)
+                    self.book.mark_good(a)
+                except Exception as e:
+                    self.logger.debug("pex dial %s failed: %s", a, e)
+
+            threading.Thread(target=_dial, name="pex-dial", daemon=True).start()
+        # still starving? ask a random connected peer for more addresses
+        if self.book.size() < need:
+            peers = sw.peers.list()
+            if peers:
+                import random
+
+                self._request_addrs(random.choice(peers))

@@ -236,11 +236,14 @@ class Switch(BaseService):
             if self._conn_is_canonical(
                 up.outbound, up.node_info.id
             ) and not self._conn_is_canonical(existing.outbound, existing.id):
-                # the new conn is the agreed survivor: evict the old one
+                # the new conn is the agreed survivor: evict the old one,
+                # and INHERIT its persistence — the replacement must keep the
+                # reconnect guarantee the evicted conn carried
                 self.logger.info(
                     "replacing non-canonical duplicate conn to %s",
                     up.node_info.id[:8],
                 )
+                persistent = persistent or existing.persistent
                 self._stop_and_remove_peer(existing, "duplicate (non-canonical)")
             else:
                 up.conn.close()

@@ -1,0 +1,2 @@
+"""Operational tools: tm-bench (tx load generator) and tm-monitor (multi-node
+health dashboard) equivalents (ref: /root/reference/tools/)."""

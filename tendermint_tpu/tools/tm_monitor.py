@@ -1,0 +1,179 @@
+"""tm-monitor — multi-node health/uptime dashboard
+(ref: tools/tm-monitor/monitor/monitor.go:21, node.go, network.go).
+
+Tracks N nodes over RPC + websocket NewBlock events: per-node height,
+latency, uptime %, and network-wide health (all nodes online + heights in
+agreement). Renders a refreshing table, or JSON snapshots with --json.
+
+Usage:
+    python -m tendermint_tpu.tools.tm_monitor tcp://127.0.0.1:26657,tcp://...
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import threading
+import time
+from typing import Dict, List, Optional
+
+from tendermint_tpu.rpc.client import HTTPClient, WSEventClient
+
+
+class NodeMonitor:
+    """One node's live stats (monitor/node.go)."""
+
+    def __init__(self, addr: str):
+        self.addr = addr
+        self.online = False
+        self.moniker = "?"
+        self.network = "?"
+        self.height = 0
+        self.block_latency_ms = 0.0
+        self._last_block_at: Optional[float] = None
+        self._started = time.monotonic()
+        self._online_time = 0.0
+        self._last_poll = self._started
+        self._stop = threading.Event()
+        self._ws: Optional[WSEventClient] = None
+        threading.Thread(target=self._run, daemon=True).start()
+
+    def _run(self) -> None:
+        client = HTTPClient(self.addr, timeout=3.0)
+        while not self._stop.is_set():
+            now = time.monotonic()
+            try:
+                st = client.status()
+                self.moniker = st["node_info"]["moniker"]
+                self.network = st["node_info"]["network"]
+                self.height = int(st["sync_info"]["latest_block_height"])
+                if self.online:
+                    self._online_time += now - self._last_poll
+                self.online = True
+                if self._ws is None:
+                    self._connect_ws()
+            except Exception:
+                self.online = False
+                if self._ws is not None:
+                    self._ws.close()  # else the socket + watcher thread leak
+                    self._ws = None
+            self._last_poll = now
+            self._stop.wait(1.0)
+
+    def _connect_ws(self) -> None:
+        try:
+            ws = WSEventClient(self.addr, timeout=3.0)
+            ws.subscribe("tm.event = 'NewBlock'")
+            self._ws = ws
+            threading.Thread(target=self._watch_blocks, daemon=True).start()
+        except Exception:
+            self._ws = None
+
+    def _watch_blocks(self) -> None:
+        ws = self._ws
+        while not self._stop.is_set() and ws is not None:
+            try:
+                ev = ws.next_event(timeout=1.0)
+            except Exception:
+                if self._ws is not ws:
+                    return
+                continue
+            now = time.monotonic()
+            header = ev["data"]["value"]["block"]["header"]
+            self.height = max(self.height, int(header["height"]))
+            if self._last_block_at is not None:
+                self.block_latency_ms = round((now - self._last_block_at) * 1e3, 1)
+            self._last_block_at = now
+
+    @property
+    def uptime_pct(self) -> float:
+        total = time.monotonic() - self._started
+        return round(100.0 * self._online_time / total, 1) if total > 0 else 0.0
+
+    def snapshot(self) -> dict:
+        return {
+            "addr": self.addr,
+            "moniker": self.moniker,
+            "network": self.network,
+            "online": self.online,
+            "height": self.height,
+            "block_interval_ms": self.block_latency_ms,
+            "uptime_pct": self.uptime_pct,
+        }
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._ws is not None:
+            self._ws.close()
+
+
+class NetworkMonitor:
+    """Aggregates node monitors into network health (monitor/network.go)."""
+
+    def __init__(self, addrs: List[str]):
+        self.nodes = [NodeMonitor(a) for a in addrs]
+
+    def health(self) -> str:
+        ups = [n for n in self.nodes if n.online]
+        if not ups:
+            return "dead"
+        if len(ups) < len(self.nodes):
+            return "moderate"
+        heights = [n.height for n in ups]
+        if max(heights) - min(heights) > 5:
+            return "moderate"  # someone lags
+        return "full"
+
+    def snapshot(self) -> dict:
+        return {
+            "health": self.health(),
+            "num_nodes": len(self.nodes),
+            "num_online": sum(1 for n in self.nodes if n.online),
+            "max_height": max((n.height for n in self.nodes), default=0),
+            "nodes": [n.snapshot() for n in self.nodes],
+        }
+
+    def stop(self) -> None:
+        for n in self.nodes:
+            n.stop()
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("endpoints", help="comma-separated tcp://host:port list")
+    p.add_argument("--json", action="store_true", help="emit JSON snapshots")
+    p.add_argument("--interval", type=float, default=2.0)
+    p.add_argument("--iterations", type=int, default=0, help="0 = forever")
+    args = p.parse_args(argv)
+
+    net = NetworkMonitor([a.strip() for a in args.endpoints.split(",") if a.strip()])
+    i = 0
+    try:
+        while True:
+            time.sleep(args.interval)
+            snap = net.snapshot()
+            if args.json:
+                print(json.dumps(snap), flush=True)
+            else:
+                print(f"\nnetwork: {snap['health']}  "
+                      f"({snap['num_online']}/{snap['num_nodes']} online, "
+                      f"height {snap['max_height']})")
+                print(f"{'MONIKER':<16}{'HEIGHT':>8}{'INTERVAL':>10}{'UPTIME':>8}  ADDR")
+                for n in snap["nodes"]:
+                    print(
+                        f"{n['moniker']:<16}{n['height']:>8}"
+                        f"{n['block_interval_ms']:>9}ms{n['uptime_pct']:>7}%  "
+                        f"{n['addr']}{'' if n['online'] else '  (OFFLINE)'}"
+                    )
+            i += 1
+            if args.iterations and i >= args.iterations:
+                return 0
+    except KeyboardInterrupt:
+        return 0
+    finally:
+        net.stop()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,157 @@
+"""AddrBook + PEX reactor (ref test models: p2p/pex/addrbook_test.go,
+pex_reactor_test.go).
+"""
+
+import os
+import time
+
+import pytest
+
+from tendermint_tpu.crypto.keys import PrivKeyEd25519
+from tendermint_tpu.p2p import NetAddress
+from tendermint_tpu.p2p.pex import AddrBook, PEXReactor
+from tendermint_tpu.p2p.pex.pex_reactor import (
+    decode_pex_msg,
+    encode_pex_addrs,
+    encode_pex_request,
+)
+from tendermint_tpu.p2p.test_util import make_connected_switches, make_switch
+
+from tests.test_p2p import _wait_until
+
+
+def _addr(i: int, port=26656) -> NetAddress:
+    ident = PrivKeyEd25519.generate(bytes([i]) * 32).pub_key().address().hex()
+    return NetAddress(ident, f"1.2.3.{i}", port)
+
+
+class TestAddrBook:
+    def test_add_pick_mark_good(self, tmp_path):
+        book = AddrBook(str(tmp_path / "book.json"))
+        src = _addr(1)
+        for i in range(2, 12):
+            assert book.add_address(_addr(i), src)
+        assert book.size() == 10
+        picked = book.pick_address()
+        assert picked is not None
+        book.mark_good(picked)
+        assert book.is_good(picked)
+
+    def test_strict_rejects_private(self, tmp_path):
+        book = AddrBook(str(tmp_path / "b.json"), strict=True)
+        loop = NetAddress(_addr(1).id, "127.0.0.1", 26656)
+        assert not book.add_address(loop, loop)
+        lax = AddrBook(None, strict=False)
+        assert lax.add_address(loop, loop)
+
+    def test_rejects_our_address(self):
+        book = AddrBook(None)
+        me = _addr(7)
+        book.add_our_address(me)
+        assert not book.add_address(me, _addr(8))
+
+    def test_persistence_roundtrip(self, tmp_path):
+        path = str(tmp_path / "book.json")
+        book = AddrBook(path)
+        a = _addr(3)
+        book.add_address(a, a)
+        book.mark_good(a)
+        book.save()
+        reloaded = AddrBook(path)
+        assert reloaded.size() == 1
+        assert reloaded.is_good(a)
+
+    def test_attempts_eventually_drop_new_addresses(self):
+        book = AddrBook(None)
+        a = _addr(4)
+        book.add_address(a, a)
+        for _ in range(10):
+            book.mark_attempt(a)
+        assert not book.has_address(a)
+
+    def test_get_selection_capped(self):
+        book = AddrBook(None, strict=False)
+        src = _addr(1)
+        for i in range(2, 60):
+            book.add_address(_addr(i), src)
+        sel = book.get_selection()
+        assert 1 <= len(sel) <= 250
+        assert len({a.id for a in sel}) == len(sel)
+
+    def test_wire_roundtrip(self):
+        addrs = [_addr(i) for i in range(1, 5)]
+        kind, got = decode_pex_msg(encode_pex_addrs(addrs))
+        assert kind == "addrs" and got == addrs
+        assert decode_pex_msg(encode_pex_request()) == ("request", None)
+
+
+class TestPEXReactor:
+    def test_outbound_peer_addr_exchange(self):
+        """Two switches with PEX: the dialer requests addrs, the acceptor
+        answers with its book selection."""
+        books = {}
+
+        def init(i, sw):
+            books[i] = AddrBook(None, strict=False)
+            # short period => the starving ensure loop re-requests every
+            # 0.15s; responses carry a RANDOM 23% selection (>=1 addr), so
+            # collecting all 5 extras needs a couple dozen draws
+            sw.add_reactor("pex", PEXReactor(books[i], ensure_period=0.15))
+            return sw
+
+        sws = make_connected_switches(2, init)
+        try:
+            # this test covers the exchange protocol, not dial-failure
+            # eviction: the 1.2.3.x extras are unreachable here, and BOTH
+            # ensure loops' failed dials would evict them via mark_attempt
+            # (even from the source book) while we wait — neutralize that
+            books[0].mark_attempt = lambda a: None
+            books[1].mark_attempt = lambda a: None
+            # seed sw1's book with addresses sw0 doesn't know
+            extra = [_addr(i) for i in range(50, 55)]
+            for a in extra:
+                books[1].add_address(a, a)
+            assert _wait_until(
+                lambda: all(books[0].has_address(a) for a in extra), timeout=20
+            ), books[0].size()
+        finally:
+            for sw in sws:
+                sw.stop()
+
+    def test_unsolicited_addrs_drops_peer(self):
+        def init(i, sw):
+            sw.add_reactor("pex", PEXReactor(AddrBook(None), ensure_period=5))
+            return sw
+
+        sws = make_connected_switches(2, init)
+        try:
+            peer0 = sws[1].peers.list()[0]  # sw0, as seen from sw1
+            # sw1 pushes addrs sw0 never asked for
+            peer0.send(0x00, encode_pex_addrs([_addr(9)]))
+            assert _wait_until(lambda: sws[0].peers.size() == 0, timeout=10)
+        finally:
+            for sw in sws:
+                sw.stop()
+
+    def test_ensure_peers_dials_from_book(self):
+        """A third switch's address in the book gets dialed automatically."""
+        books = {}
+
+        def init(i, sw):
+            books[i] = AddrBook(None, strict=False)
+            sw.add_reactor("pex", PEXReactor(books[i], ensure_period=0.3))
+            return sw
+
+        # two isolated switches (not connected)
+        sw_a = make_switch(0, init_switch=init, network="pexnet")
+        books_a = books[0]
+        sw_b = make_switch(1, init_switch=init, network="pexnet")
+        sw_a.start(), sw_b.start()
+        try:
+            laddr = sw_b.transport.listen("127.0.0.1:0")
+            books_a.add_address(laddr, laddr)
+            assert _wait_until(lambda: sw_a.peers.has(sw_b.node_id), timeout=15)
+            # mark_good runs in the dial thread just after peer admission
+            assert _wait_until(lambda: books_a.is_good(laddr), timeout=5)
+        finally:
+            sw_a.stop(), sw_b.stop()
