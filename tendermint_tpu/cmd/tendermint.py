@@ -109,6 +109,32 @@ def cmd_version(args) -> int:
     return 0
 
 
+def cmd_replay(args, console: bool = False) -> int:
+    """Replay the WAL through a fresh consensus state (commands/replay.go)."""
+    from tendermint_tpu.consensus.replay_file import run_replay_file
+
+    cfg = _config(args)
+    return 0 if run_replay_file(cfg, console=console) >= 0 else 1
+
+
+def cmd_replay_console(args) -> int:
+    return cmd_replay(args, console=True)
+
+
+def cmd_lite(args) -> int:
+    """Light-client verifying proxy: certify headers from an untrusted node
+    via the DynamicVerifier and serve verified /status /commit locally
+    (commands/lite.go + lite/proxy)."""
+    from tendermint_tpu.lite.proxy import run_lite_proxy
+
+    return run_lite_proxy(
+        chain_id=args.chain_id,
+        node_addr=args.node,
+        laddr=args.laddr,
+        home=_home(args),
+    )
+
+
 def cmd_gen_validator(args) -> int:
     from tendermint_tpu.crypto.keys import PrivKeyEd25519
 
@@ -261,6 +287,20 @@ def main(argv=None) -> int:
     ]:
         sp = sub.add_parser(name)
         sp.set_defaults(fn=fn)
+
+    sp = sub.add_parser("replay", help="replay the consensus WAL")
+    sp.add_argument("--proxy_app", default="kvstore")
+    sp.set_defaults(fn=cmd_replay)
+
+    sp = sub.add_parser("replay_console", help="interactive WAL replay")
+    sp.add_argument("--proxy_app", default="kvstore")
+    sp.set_defaults(fn=cmd_replay_console)
+
+    sp = sub.add_parser("lite", help="light-client verifying proxy")
+    sp.add_argument("--chain-id", required=True)
+    sp.add_argument("--node", default="tcp://127.0.0.1:26657")
+    sp.add_argument("--laddr", default="tcp://127.0.0.1:8888")
+    sp.set_defaults(fn=cmd_lite)
 
     sp = sub.add_parser("testnet", help="generate a testnet config tree")
     sp.add_argument("--v", type=int, default=4)

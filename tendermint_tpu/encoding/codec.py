@@ -50,6 +50,9 @@ def encode_uvarint(n: int) -> bytes:
 
 
 def read_uvarint(buf: io.BytesIO) -> int:
+    """Wire uvarints are uint64 — anything larger is malformed input and
+    must be REJECTED identically by this and the native reader (divergent
+    acceptance between codec backends would split the network)."""
     shift = 0
     out = 0
     while True:
@@ -57,11 +60,13 @@ def read_uvarint(buf: io.BytesIO) -> int:
         if not ch:
             raise EOFError("truncated uvarint")
         b = ch[0]
+        if shift == 63 and b > 1:
+            raise ValueError("uvarint overflows uint64")
         out |= (b & 0x7F) << shift
         if not (b & 0x80):
             return out
         shift += 7
-        if shift > 70:
+        if shift > 63:
             raise ValueError("uvarint too long")
 
 
@@ -126,7 +131,7 @@ def read_length_prefixed(buf: io.BytesIO) -> bytes:
     return read_bytes(buf)
 
 
-class Writer:
+class _PyWriter:
     """Ordered-field struct writer; every encoder in types/ uses this.
     Backed by a bytearray — this is the hottest object in block
     application/serialization."""
@@ -171,7 +176,7 @@ class Writer:
         return bytes(self._buf)
 
 
-class Reader:
+class _PyReader:
     def __init__(self, data: bytes) -> None:
         self._buf = io.BytesIO(data)
 
@@ -208,3 +213,21 @@ class Reader:
 
     def at_end(self) -> bool:
         return self.remaining() == 0
+
+
+# ---------------------------------------------------------------------------
+# Native acceleration: the C extension (encoding/_codec_native.c) implements
+# Writer/Reader with identical wire behavior; block application is
+# serialization-bound, so the constant factor matters (fast sync blocks/s).
+# Pure-Python classes remain as the reference implementation + fallback.
+# ---------------------------------------------------------------------------
+
+from tendermint_tpu.encoding import native as _native_loader
+
+_native = _native_loader.load()
+if _native is not None:
+    Writer = _native.Writer
+    Reader = _native.Reader
+else:
+    Writer = _PyWriter
+    Reader = _PyReader

@@ -1,0 +1,59 @@
+"""Build + load the native codec extension (encoding/_codec_native.c).
+
+Compiled lazily on first import (cc against the running interpreter's
+headers, cached next to the source, rebuilt when the .c changes); any
+failure falls back to the pure-Python codec — behavior is identical, only
+the constant factor changes. Set TM_NO_NATIVE_CODEC=1 to force the
+fallback (tests exercise both paths).
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import subprocess
+import sys
+import sysconfig
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "_codec_native.c")
+_SO = os.path.join(
+    _HERE, f"_codec_native.{sysconfig.get_config_var('SOABI')}.so"
+)
+
+
+def _build() -> bool:
+    include = sysconfig.get_paths()["include"]
+    cc = os.environ.get("CC", "cc")
+    # unique temp path: N processes building concurrently (localnet launch)
+    # must not interleave writes into one file — a corrupt .so with a fresh
+    # mtime would silently disable the native codec forever
+    tmp = f"{_SO}.{os.getpid()}.tmp"
+    cmd = [cc, "-O2", "-shared", "-fPIC", f"-I{include}", _SRC, "-o", tmp]
+    try:
+        res = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
+    except Exception:
+        return False
+    if res.returncode != 0:
+        sys.stderr.write(f"codec native build failed:\n{res.stderr[-1000:]}\n")
+        return False
+    os.replace(tmp, _SO)
+    return True
+
+
+def load():
+    """The compiled module, or None when unavailable."""
+    if os.environ.get("TM_NO_NATIVE_CODEC"):
+        return None
+    try:
+        if not os.path.exists(_SO) or os.path.getmtime(_SO) < os.path.getmtime(_SRC):
+            if not _build():
+                return None
+        spec = importlib.util.spec_from_file_location(
+            "tendermint_tpu.encoding._codec_native", _SO
+        )
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+    except Exception:
+        return None

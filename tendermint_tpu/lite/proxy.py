@@ -1,0 +1,190 @@
+"""Light-client verifying proxy (ref: lite/proxy/proxy.go, wrapper.go and
+the `lite` CLI command, cmd/tendermint/commands/lite.go).
+
+``RPCProvider`` feeds the DynamicVerifier FullCommits fetched from an
+UNTRUSTED full node over RPC (codec-exact bytes via /lite_full_commit).
+``run_lite_proxy`` serves a local HTTP endpoint whose /commit and /status
+responses are only ever derived from headers the verifier certified —
+a caller of the proxy needs no trust in the backing node.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+from urllib.parse import parse_qs, urlparse
+
+from tendermint_tpu.encoding.codec import Reader
+from tendermint_tpu.libs.db.kv import new_db
+from tendermint_tpu.lite.provider import DBProvider, Provider, ProviderError
+from tendermint_tpu.lite.types import FullCommit, LiteError, SignedHeader
+from tendermint_tpu.lite.verifier import DynamicVerifier
+from tendermint_tpu.rpc.client import HTTPClient, RPCClientError
+from tendermint_tpu.types.block import Commit, Header
+from tendermint_tpu.types.validator_set import ValidatorSet
+
+
+class RPCProvider(Provider):
+    """Source provider over an untrusted node's RPC (lite/client/provider.go)."""
+
+    def __init__(self, addr: str):
+        self._client = HTTPClient(addr)
+
+    def latest_full_commit(
+        self, chain_id: str, min_height: int, max_height: int
+    ) -> FullCommit:
+        status = self._client.status()
+        top = min(max_height, int(status["sync_info"]["latest_block_height"]))
+        for h in range(top, min_height - 1, -1):
+            try:
+                return self.full_commit_at(chain_id, h)
+            except ProviderError:
+                continue
+        raise ProviderError(f"no full commit in [{min_height},{max_height}]")
+
+    def full_commit_at(self, chain_id: str, height: int) -> FullCommit:
+        try:
+            raw = self._client.call("lite_full_commit", height=height)
+        except RPCClientError as e:
+            raise ProviderError(str(e)) from e
+        header = Header.decode(Reader(base64.b64decode(raw["header"])))
+        commit = Commit.unmarshal(base64.b64decode(raw["commit"]))
+        vals = ValidatorSet.unmarshal(base64.b64decode(raw["validators"]))
+        next_vals = ValidatorSet.unmarshal(base64.b64decode(raw["next_validators"]))
+        return FullCommit(
+            signed_header=SignedHeader(header=header, commit=commit),
+            validators=vals,
+            next_validators=next_vals,
+        )
+
+
+class LiteProxy:
+    """Certifies heights on demand and serves them (lite/proxy/proxy.go)."""
+
+    def __init__(self, chain_id: str, node_addr: str, trust_db=None):
+        self.chain_id = chain_id
+        self.source = RPCProvider(node_addr)
+        self.trusted = DBProvider(trust_db if trust_db is not None else _memdb())
+        self.verifier = DynamicVerifier(chain_id, self.trusted, self.source)
+        self._client = HTTPClient(node_addr)
+        self._seeded = False
+
+    def _ensure_seed(self) -> None:
+        if self._seeded:
+            return
+        try:
+            self.trusted.latest_full_commit(self.chain_id, 1, 1 << 60)
+        except ProviderError:
+            # TOFU seed at the node's earliest available height (commands/
+            # lite.go trusts the first fetch; operators can pre-seed the DB)
+            fc = self.source.full_commit_at(self.chain_id, 1)
+            self.verifier.init_from_full_commit(fc)
+        self._seeded = True
+
+    def certified_commit(self, height: Optional[int] = None) -> FullCommit:
+        """FullCommit for `height` (default: node tip), verified."""
+        self._ensure_seed()
+        if height is None:
+            status = self._client.status()
+            height = int(status["sync_info"]["latest_block_height"])
+            # the tip's canonical commit may not be stored yet; step back
+            height = max(1, height - 1)
+        fc = self.source.full_commit_at(self.chain_id, height)
+        self.verifier.verify(fc.signed_header)
+        return fc
+
+    def status(self) -> dict:
+        fc = self.certified_commit()
+        h = fc.signed_header.header
+        return {
+            "verified": True,
+            "chain_id": h.chain_id,
+            "latest_block_height": h.height,
+            "latest_app_hash": h.app_hash.hex().upper(),
+            "latest_block_time_ns": h.time_ns,
+        }
+
+    def commit(self, height: Optional[int] = None) -> dict:
+        fc = self.certified_commit(height)
+        h = fc.signed_header.header
+        return {
+            "verified": True,
+            "header": {
+                "chain_id": h.chain_id,
+                "height": h.height,
+                "app_hash": h.app_hash.hex().upper(),
+                "validators_hash": h.validators_hash.hex().upper(),
+                "time_ns": h.time_ns,
+            },
+            "commit": {
+                "block_id_hash": fc.signed_header.commit.block_id.hash.hex().upper(),
+                "precommits": sum(
+                    1 for pc in fc.signed_header.commit.precommits if pc
+                ),
+            },
+        }
+
+
+def _memdb():
+    from tendermint_tpu.libs.db.kv import MemDB
+
+    return MemDB()
+
+
+def run_lite_proxy(chain_id: str, node_addr: str, laddr: str, home: str) -> int:
+    """Serve /status and /commit?height=N with verified-only data."""
+    import os
+
+    trust_db = new_db("lite_trust", "sqlite", os.path.join(home, "data"))
+    proxy = LiteProxy(chain_id, node_addr, trust_db)
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, fmt, *args):
+            pass
+
+        def do_GET(self):
+            parsed = urlparse(self.path)
+            q = {k: v[0] for k, v in parse_qs(parsed.query).items()}
+            try:
+                if parsed.path == "/status":
+                    out = proxy.status()
+                elif parsed.path == "/commit":
+                    try:
+                        height = int(q["height"]) if "height" in q else None
+                    except ValueError:
+                        body = json.dumps({"error": "bad height"}).encode()
+                        self.send_response(400)
+                        self._finish(body)
+                        return
+                    out = proxy.commit(height)
+                else:
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                body = json.dumps({"result": out}).encode()
+                self.send_response(200)
+            except Exception as e:
+                # LiteError/ProviderError, but also a dead backing node
+                # (socket errors) — callers must get an HTTP error, not a
+                # reset connection
+                body = json.dumps({"error": str(e)}).encode()
+                self.send_response(502)
+            self._finish(body)
+
+        def _finish(self, body: bytes) -> None:
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    host, _, port = laddr.replace("tcp://", "").rpartition(":")
+    httpd = ThreadingHTTPServer((host or "127.0.0.1", int(port)), Handler)
+    print(f"lite proxy verifying {node_addr} (chain {chain_id}) on {laddr}", flush=True)
+    try:
+        httpd.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    return 0

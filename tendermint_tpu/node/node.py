@@ -255,7 +255,6 @@ class Node(BaseService):
         self.switch.add_reactor("mempool", mem_reactor)
         self.switch.add_reactor("evidence", ev_reactor)
         if pex_reactor is not None:
-            self.switch.addr_book = self.addr_book
             self.switch.add_reactor("pex", pex_reactor)
 
     # lifecycle -------------------------------------------------------------

@@ -75,7 +75,6 @@ class Switch(BaseService):
         self._dialing: set = set()
         self._reconnecting: set = set()
         self._mtx = threading.Lock()
-        self.addr_book = None  # set by PEX wiring (node composition)
 
     # -- reactor registry ---------------------------------------------------------
     def add_reactor(self, name: str, reactor: Reactor) -> Reactor:

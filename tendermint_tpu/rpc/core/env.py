@@ -93,6 +93,34 @@ class RPCEnv:
             "canonical": bs.load_block_commit(h) is not None,
         }
 
+    def lite_full_commit(self, height: Optional[int] = None) -> dict:
+        """Codec-exact light-client material: header+commit+valsets as b64
+        marshal bytes (what lite/proxy's RPCProvider consumes; JSON field
+        re-serialization could never be hash-exact)."""
+        from tendermint_tpu.encoding.codec import Writer
+        from tendermint_tpu.state import store as sm_store
+
+        bs = self.node.block_store
+        h = int(height) if height else bs.height()
+        meta = bs.load_block_meta(h)
+        commit = bs.load_block_commit(h) or bs.load_seen_commit(h)
+        if meta is None or commit is None:
+            raise RPCError(-32603, f"no commit for height {h}")
+        try:
+            vals = sm_store.load_validators(self.node.state_db, h)
+            next_vals = sm_store.load_validators(self.node.state_db, h + 1)
+        except Exception as e:
+            raise RPCError(-32603, f"no validators for {h}: {e}")
+        w = Writer()
+        meta.header.encode(w)
+        return {
+            "height": h,
+            "header": _b64(w.build()),
+            "commit": _b64(commit.marshal()),
+            "validators": _b64(vals.marshal()),
+            "next_validators": _b64(next_vals.marshal()),
+        }
+
     def validators(self, height: Optional[int] = None) -> dict:
         from tendermint_tpu.state import store as sm_store
 

@@ -1,0 +1,79 @@
+"""Native codec extension: byte-parity with the pure-Python reference
+implementation over randomized field sequences, plus error behavior."""
+
+import random
+
+import pytest
+
+from tendermint_tpu.encoding import codec
+from tendermint_tpu.encoding import native
+
+
+@pytest.fixture(scope="module")
+def native_mod():
+    mod = native.load()
+    if mod is None:
+        pytest.skip("native codec unavailable")
+    return mod
+
+
+def _random_ops(rng, n=200):
+    ops = []
+    for _ in range(n):
+        kind = rng.choice(["uvarint", "svarint", "fixed64", "bytes", "string", "bool"])
+        if kind == "uvarint":
+            ops.append((kind, rng.randrange(0, 1 << 63)))
+        elif kind == "svarint":
+            ops.append((kind, rng.randrange(-(1 << 62), 1 << 62)))
+        elif kind == "fixed64":
+            ops.append((kind, rng.randrange(-(1 << 63), 1 << 63)))
+        elif kind == "bytes":
+            ops.append((kind, rng.randbytes(rng.randrange(0, 300))))
+        elif kind == "string":
+            ops.append((kind, "".join(chr(rng.randrange(32, 0x2FF)) for _ in range(rng.randrange(0, 40)))))
+        else:
+            ops.append((kind, rng.random() < 0.5))
+    return ops
+
+
+class TestNativeParity:
+    def test_writer_byte_parity(self, native_mod):
+        rng = random.Random(11)
+        for _ in range(10):
+            ops = _random_ops(rng)
+            wp, wn = codec._PyWriter(), native_mod.Writer()
+            for kind, val in ops:
+                getattr(wp, kind)(val)
+                getattr(wn, kind)(val)
+            assert wp.build() == wn.build()
+
+    def test_reader_roundtrip_parity(self, native_mod):
+        rng = random.Random(12)
+        ops = _random_ops(rng)
+        w = codec._PyWriter()
+        for kind, val in ops:
+            getattr(w, kind)(val)
+        data = w.build()
+        rp, rn = codec._PyReader(data), native_mod.Reader(data)
+        for kind, val in ops:
+            got_p = getattr(rp, kind)()
+            got_n = getattr(rn, kind)()
+            assert got_p == got_n == val, (kind, val)
+        assert rn.at_end() and rp.at_end()
+
+    def test_native_reader_truncation_raises(self, native_mod):
+        r = native_mod.Reader(b"\x05ab")
+        with pytest.raises(EOFError):
+            r.bytes()
+        with pytest.raises(EOFError):
+            native_mod.Reader(b"").uvarint()
+
+    def test_negative_uvarint_rejected(self, native_mod):
+        with pytest.raises(ValueError):
+            native_mod.Writer().uvarint(-1)
+
+    def test_chaining(self, native_mod):
+        w = native_mod.Writer()
+        out = w.uvarint(1).svarint(-2).bool(True).string("x").build()
+        wp = codec._PyWriter()
+        assert out == wp.uvarint(1).svarint(-2).bool(True).string("x").build()

@@ -1,0 +1,195 @@
+"""Periphery: abci-cli, replay/replay_console, lite proxy, fuzzed conn,
+trust metric (ref: abci/cmd/abci-cli, cmd replay.go, lite/proxy,
+p2p/fuzz.go, p2p/trust/).
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _env():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["TM_BATCH_VERIFIER"] = "host"
+    return env
+
+
+class TestAbciCli:
+    def test_batch_against_local_kvstore(self):
+        script = b'deliver_tx "k1=v1"\ncommit\nquery "k1"\ninfo\n'
+        res = subprocess.run(
+            [sys.executable, "-m", "tendermint_tpu.cmd.abci_cli",
+             "--app", "kvstore", "batch"],
+            input=script, capture_output=True, cwd=REPO, env=_env(), timeout=60,
+        )
+        out = res.stdout.decode()
+        assert res.returncode == 0, res.stderr.decode()
+        assert "value: 0x" + b"v1".hex().upper() in out
+        assert "last_block_height: 1" in out
+
+    def test_against_socket_server(self):
+        from tendermint_tpu.abci.examples.kvstore import KVStoreApp
+        from tendermint_tpu.abci.server import ABCIServer
+
+        srv = ABCIServer("tcp://127.0.0.1:0", KVStoreApp())
+        srv.start()
+        try:
+            addr = f"tcp://127.0.0.1:{srv.bound_port}"
+            res = subprocess.run(
+                [sys.executable, "-m", "tendermint_tpu.cmd.abci_cli",
+                 "--address", addr, "echo", "hello-over-socket"],
+                capture_output=True, text=True, cwd=REPO, env=_env(), timeout=60,
+            )
+            assert res.returncode == 0, res.stderr
+        finally:
+            srv.stop()
+
+
+class TestReplayFile:
+    def test_replay_wal_reaches_recorded_height(self, tmp_path):
+        """Run a durable node to height >=3 via the crash runner, then replay
+        its WAL from scratch and reach the same heights."""
+        home = str(tmp_path / "node")
+        run = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tests", "crash_runner.py"),
+             home, "3"],
+            capture_output=True, text=True, cwd=REPO, env=_env(), timeout=150,
+        )
+        assert run.returncode == 0, run.stderr[-1500:]
+
+        from tendermint_tpu.config.config import default_config, test_config
+        from tendermint_tpu.consensus.replay_file import run_replay_file
+
+        cfg = default_config()
+        cfg.set_root(home)
+        cfg.base.proxy_app = "kvstore"
+        cfg.p2p.laddr = ""
+        cfg.consensus = test_config().consensus
+        n = run_replay_file(cfg, console=False)
+        assert n > 0
+
+
+class TestLiteProxy:
+    def test_proxy_serves_verified_commits(self, tmp_path):
+        from tests.test_ws_metrics import live_node  # noqa: F401 (fixture import)
+        # build a live node inline (fixture machinery without pytest param)
+        from tendermint_tpu.config.config import default_config, test_config
+        from tendermint_tpu.node.node import Node
+        from tendermint_tpu.privval.file_pv import FilePV
+        from tendermint_tpu.types import GenesisDoc, GenesisValidator
+        from tests.consensus_harness import wait_for
+
+        home = str(tmp_path / "n")
+        cfg = default_config()
+        cfg.set_root(home)
+        cfg.base.proxy_app = "kvstore"
+        cfg.base.db_backend = "memdb"
+        cfg.rpc.laddr = "tcp://127.0.0.1:0"
+        cfg.p2p.laddr = ""
+        cfg.consensus = test_config().consensus
+        cfg.consensus.wal_path = ""
+        os.makedirs(os.path.join(home, "config"), exist_ok=True)
+        pv = FilePV.generate(os.path.join(home, "config", "pv.json"))
+        doc = GenesisDoc(
+            chain_id="lite-proxy-chain",
+            genesis_time_ns=1_700_000_000_000_000_000,
+            validators=[GenesisValidator(pv.get_pub_key(), 10)],
+        )
+        doc.validate_and_complete()
+        node = Node(cfg, priv_validator=pv, genesis_doc=doc)
+        node.start()
+        try:
+            assert wait_for(lambda: node.block_store.height() >= 4, timeout=30)
+            from tendermint_tpu.lite.proxy import LiteProxy
+
+            proxy = LiteProxy(
+                "lite-proxy-chain",
+                f"tcp://127.0.0.1:{node.rpc_server.bound_port}",
+            )
+            st = proxy.status()
+            assert st["verified"] and st["latest_block_height"] >= 2
+            cm = proxy.commit(2)
+            assert cm["verified"] and cm["header"]["height"] == 2
+            # wrong chain id: verification refuses
+            from tendermint_tpu.lite import LiteError
+            from tendermint_tpu.lite.provider import ProviderError
+
+            bad = LiteProxy(
+                "other-chain", f"tcp://127.0.0.1:{node.rpc_server.bound_port}"
+            )
+            with pytest.raises((LiteError, ProviderError)):
+                bad.status()
+        finally:
+            node.stop()
+
+
+class TestFuzzedConnection:
+    def test_drop_mode_loses_writes(self):
+        import random
+
+        from tendermint_tpu.p2p.conn.secret_connection import RawConn
+        from tendermint_tpu.p2p.fuzz import FuzzConfig, FuzzedConnection
+
+        s1, s2 = socket.socketpair()
+        fz = FuzzedConnection(
+            RawConn(s1), FuzzConfig(mode="drop", prob_drop_rw=1.0),
+            rng=random.Random(1),
+        )
+        fz.write(b"dropped")
+        s1.sendall(b"real")  # bypass: proves the socket still works
+        assert s2.recv(100) == b"real"
+        fz.close(), s2.close()
+
+    def test_delay_mode_delivers_slowly(self):
+        import random
+
+        from tendermint_tpu.p2p.conn.secret_connection import RawConn
+        from tendermint_tpu.p2p.fuzz import FuzzConfig, FuzzedConnection
+
+        s1, s2 = socket.socketpair()
+        fz = FuzzedConnection(
+            RawConn(s1), FuzzConfig(mode="delay", max_delay=0.05),
+            rng=random.Random(2),
+        )
+        t0 = time.monotonic()
+        fz.write(b"slow")
+        assert s2.recv(10) == b"slow"
+        fz.close(), s2.close()
+
+
+class TestTrustMetric:
+    def test_good_and_bad_events_move_score(self):
+        from tendermint_tpu.p2p.trust import TrustMetric
+
+        m = TrustMetric()
+        assert m.trust_score() == 100  # innocent until proven otherwise
+        for _ in range(10):
+            m.bad_event()
+        low = m.trust_score()
+        assert low < 100
+        for _ in range(50):
+            m.good_event()
+        assert m.trust_score() > low
+
+    def test_store_persistence(self, tmp_path):
+        from tendermint_tpu.p2p.trust import TrustMetricStore
+
+        path = str(tmp_path / "trust.json")
+        store = TrustMetricStore(path)
+        m = store.get_metric("peer-a")
+        for _ in range(10):
+            m.bad_event()
+        score = store.peer_score("peer-a")
+        store.save()
+        reloaded = TrustMetricStore(path)
+        assert abs(reloaded.peer_score("peer-a") - score) <= 45
+        assert reloaded.peer_score("peer-a") < 100
