@@ -1,0 +1,254 @@
+"""gRPC transport for ABCI + the rpc-level BroadcastAPI
+(ref: abci/client/grpc_client.go, abci/server/grpc_server.go,
+rpc/grpc/api.go BroadcastAPI with Ping/BroadcastTx).
+
+No generated protobuf stubs: grpc's generic handler API with the framework's
+deterministic JSON message codec (abci/types.msg_to_json) as the
+request/response serializer. Wire compatibility with the reference's
+protobuf schema is a non-goal (like amino, SURVEY §7.2) — the CONTRACT
+(method set, req/resp shapes, one-RPC-per-ABCI-call semantics) is what's
+mirrored.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Optional
+
+import grpc
+
+from tendermint_tpu.abci import types as abci
+from tendermint_tpu.libs.service import BaseService
+
+_SERVICE = "tendermint.abci.ABCIApplication"
+
+# gRPC method name -> Application method name
+_METHODS = {
+    "Echo": "echo",
+    "Flush": "flush",
+    "Info": "info",
+    "SetOption": "set_option",
+    "DeliverTx": "deliver_tx",
+    "CheckTx": "check_tx",
+    "Query": "query",
+    "Commit": "commit",
+    "InitChain": "init_chain",
+    "BeginBlock": "begin_block",
+    "EndBlock": "end_block",
+}
+
+
+class GRPCServer(BaseService):
+    """Serves an Application over gRPC (abci/server/grpc_server.go)."""
+
+    def __init__(self, addr: str, app: abci.Application):
+        super().__init__("abci.GRPCServer")
+        self.addr = addr.replace("tcp://", "")
+        self.app = app
+        self._server: Optional[grpc.Server] = None
+        self.bound_port: Optional[int] = None
+
+    def on_start(self) -> None:
+        from concurrent import futures
+
+        mtx = threading.Lock()  # ABCI calls are serialized like LocalClient
+
+        def make_handler(app_method: str):
+            if app_method == "flush":
+                # Flush is transport-level, not an Application method
+                # (the socket server answers it inline too)
+                return lambda request, context: abci.ResponseFlush()
+
+            def handler(request, context):
+                with mtx:
+                    return getattr(self.app, app_method)(request)
+
+            return handler
+
+        handlers = {
+            name: grpc.unary_unary_rpc_method_handler(
+                make_handler(app_method),
+                request_deserializer=abci.msg_from_json,
+                response_serializer=abci.msg_to_json,
+            )
+            for name, app_method in _METHODS.items()
+        }
+        self._server = grpc.server(futures.ThreadPoolExecutor(max_workers=4))
+        self._server.add_generic_rpc_handlers(
+            (grpc.method_handlers_generic_handler(_SERVICE, handlers),)
+        )
+        self.bound_port = self._server.add_insecure_port(self.addr)
+        if self.bound_port == 0:
+            raise OSError(f"could not bind gRPC ABCI server to {self.addr}")
+        self._server.start()
+        self.logger.info("ABCI gRPC server on %s", self.addr)
+
+    def on_stop(self) -> None:
+        if self._server is not None:
+            self._server.stop(grace=1.0)
+
+
+class GRPCClient(BaseService):
+    """ABCI client over gRPC — same surface as SocketClient/LocalClient
+    (abci/client/grpc_client.go): <method>_sync calls + request_async shim."""
+
+    CONNECT_TIMEOUT = 5.0
+
+    def __init__(self, addr: str, must_connect: bool = True):
+        super().__init__("abci.GRPCClient")
+        self.addr = addr.replace("tcp://", "")
+        self._must_connect = must_connect
+        self._channel: Optional[grpc.Channel] = None
+        self._stubs = {}
+        self._cb = None
+        self._err: Optional[Exception] = None
+
+    def on_start(self) -> None:
+        self._channel = grpc.insecure_channel(self.addr)
+        if self._must_connect:
+            # channels are lazy: fail FAST at start like SocketClient does,
+            # not deep inside the first consensus handshake call
+            grpc.channel_ready_future(self._channel).result(
+                timeout=self.CONNECT_TIMEOUT
+            )
+        # per-method stubs built once — DeliverTx/CheckTx are per-tx hot
+        self._stubs = {
+            name: self._channel.unary_unary(
+                f"/{_SERVICE}/{name}",
+                request_serializer=abci.msg_to_json,
+                response_deserializer=abci.msg_from_json,
+            )
+            for name in _METHODS
+        }
+
+    def on_stop(self) -> None:
+        if self._channel is not None:
+            self._channel.close()
+
+    def set_response_callback(self, cb) -> None:
+        self._cb = cb
+
+    def error(self) -> Optional[Exception]:
+        return self._err
+
+    def _call(self, method: str, req: Any) -> Any:
+        stub = self._stubs[method]
+        try:
+            res = stub(req)
+        except grpc.RpcError as e:
+            self._err = e
+            raise
+        if self._cb is not None:
+            self._cb(req, res)
+        return res
+
+    def request_sync(self, req: Any) -> Any:
+        name = type(req).__name__.removeprefix("Request")
+        return self._call(name, req)
+
+    def request_async(self, req: Any):
+        from tendermint_tpu.abci.client import ReqRes
+
+        rr = ReqRes(req)
+        rr.complete(self.request_sync(req))
+        return rr
+
+    def flush_sync(self) -> None:
+        self._call("Flush", abci.RequestFlush())
+
+    def flush_async(self) -> None:
+        self.flush_sync()
+
+    def __getattr__(self, name: str):
+        # echo_sync / deliver_tx_sync / ... -> one gRPC call each
+        # ("deliver_tx" capitalizes segment-wise to "DeliverTx")
+        if name.endswith("_sync"):
+            method = "".join(p.capitalize() for p in name[:-5].split("_"))
+            return lambda req: self._call(method, req)
+        if name.endswith("_async"):
+            return self.request_async
+        raise AttributeError(name)
+
+
+# ---------------------------------------------------------------------------
+# rpc-level BroadcastAPI (rpc/grpc/api.go): Ping + BroadcastTx convenience
+# ---------------------------------------------------------------------------
+
+_BROADCAST_SERVICE = "tendermint.rpc.grpc.BroadcastAPI"
+
+
+class BroadcastAPIServer(BaseService):
+    """gRPC BroadcastTx endpoint wired to a node's mempool + CheckTx result
+    (node.go startRPC's grpccore.StartGRPCServer)."""
+
+    def __init__(self, addr: str, node):
+        super().__init__("rpc.GRPCBroadcast")
+        self.addr = addr.replace("tcp://", "")
+        self.node = node
+        self._server = None
+        self.bound_port: Optional[int] = None
+
+    def on_start(self) -> None:
+        import json
+        import queue as q
+        from concurrent import futures
+
+        node = self.node
+
+        def ping(request, context):
+            return b"{}"
+
+        def broadcast_tx(request, context):
+            from tendermint_tpu.mempool.mempool import MempoolError
+
+            tx = bytes(json.loads(request)["tx"].encode("latin1"))
+            done: "q.Queue" = q.Queue()
+            try:
+                node.mempool.check_tx(tx, callback=done.put)
+            except MempoolError as e:
+                # duplicate/full/oversized: a structured error, matching the
+                # HTTP path's behavior on the same input
+                return json.dumps({"error": str(e)}).encode()
+            try:
+                res = done.get(timeout=10)
+            except q.Empty:
+                context.abort(grpc.StatusCode.DEADLINE_EXCEEDED, "CheckTx timeout")
+            return json.dumps(
+                {"check_tx": {"code": res.code, "log": res.log}}
+            ).encode()
+
+        handlers = {
+            "Ping": grpc.unary_unary_rpc_method_handler(
+                ping, request_deserializer=None, response_serializer=None
+            ),
+            "BroadcastTx": grpc.unary_unary_rpc_method_handler(
+                broadcast_tx, request_deserializer=None, response_serializer=None
+            ),
+        }
+        self._server = grpc.server(futures.ThreadPoolExecutor(max_workers=4))
+        self._server.add_generic_rpc_handlers(
+            (grpc.method_handlers_generic_handler(_BROADCAST_SERVICE, handlers),)
+        )
+        self.bound_port = self._server.add_insecure_port(self.addr)
+        if self.bound_port == 0:
+            raise OSError(f"could not bind gRPC broadcast server to {self.addr}")
+        self._server.start()
+
+    def on_stop(self) -> None:
+        if self._server is not None:
+            self._server.stop(grace=1.0)
+
+
+def broadcast_tx_via_grpc(addr: str, tx: bytes, timeout: float = 10.0) -> dict:
+    """Client helper for the BroadcastAPI (rpc/grpc/client_server.go)."""
+    import json
+
+    channel = grpc.insecure_channel(addr.replace("tcp://", ""))
+    try:
+        stub = channel.unary_unary(f"/{_BROADCAST_SERVICE}/BroadcastTx")
+        res = stub(
+            json.dumps({"tx": tx.decode("latin1")}).encode(), timeout=timeout
+        )
+        return json.loads(res)
+    finally:
+        channel.close()

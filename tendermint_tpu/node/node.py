@@ -154,6 +154,7 @@ class Node(BaseService):
             self._build_p2p(config, state)
 
         self.rpc_server = None
+        self.grpc_broadcast = None
         self._rpc_env = None
 
     def _build_p2p(self, config: Config, state) -> None:
@@ -293,6 +294,13 @@ class Node(BaseService):
             self._rpc_env = RPCEnv(self)
             self.rpc_server = RPCServer(self.config.rpc.laddr, self._rpc_env)
             self.rpc_server.start()
+        if self.config.rpc.grpc_laddr:
+            from tendermint_tpu.abci.grpc import BroadcastAPIServer
+
+            self.grpc_broadcast = BroadcastAPIServer(
+                self.config.rpc.grpc_laddr, self
+            )
+            self.grpc_broadcast.start()
         if self.switch is not None:
             # the consensus reactor starts (or fast-sync defers) the
             # consensus state; dial persistent peers after listening
@@ -336,8 +344,8 @@ class Node(BaseService):
     def on_stop(self) -> None:
         # switch first: it stops its reactors, which stop the consensus state
         services = [self.switch] if self.switch is not None else [self.consensus_state]
-        services += [self.rpc_server, self.indexer_service, self.event_bus,
-                     self.proxy_app]
+        services += [self.rpc_server, self.grpc_broadcast, self.indexer_service,
+                     self.event_bus, self.proxy_app]
         for svc in services:
             if svc is None:
                 continue

@@ -107,11 +107,18 @@ class LocalClientCreator(ClientCreator):
 
 
 class RemoteClientCreator(ClientCreator):
+    """Socket by default; 'grpc://host:port' selects the gRPC transport
+    (ref DefaultClientCreator's transport switch, proxy/client.go)."""
+
     def __init__(self, addr: str, must_connect: bool = True):
         self._addr = addr
         self._must_connect = must_connect
 
     def new_abci_client(self):
+        if self._addr.startswith("grpc://"):
+            from tendermint_tpu.abci.grpc import GRPCClient
+
+            return GRPCClient(self._addr[len("grpc://"):], self._must_connect)
         return SocketClient(self._addr, self._must_connect)
 
 

@@ -304,6 +304,46 @@ class RPCEnv:
             }
         }
 
+    # debug / profiling ------------------------------------------------------
+    def _require_unsafe(self) -> None:
+        """unsafe_* routes are operator tools, gated on config.rpc.unsafe
+        (the reference registers its unsafe routes conditionally,
+        rpc/core/routes.go:43)."""
+        if not self.node.config.rpc.unsafe:
+            raise RPCError(-32601, "unsafe RPC routes are disabled (rpc.unsafe)")
+
+    def unsafe_dump_threads(self) -> dict:
+        """Stack dump of every live thread — the pprof-goroutine analogue
+        (ref: pprof server at node/node.go:474-479)."""
+        self._require_unsafe()
+        import sys as _sys
+        import traceback
+
+        frames = _sys._current_frames()
+        out = {}
+        for t in threading.enumerate():
+            frame = frames.get(t.ident)
+            out[f"{t.name} (daemon={t.daemon})"] = (
+                traceback.format_stack(frame) if frame is not None else []
+            )
+        return {"n_threads": len(out), "stacks": out}
+
+    def unsafe_start_profiler(self, dir: str = "/tmp/tm_tpu_trace") -> dict:
+        """Start a JAX profiler trace (xprof-compatible; SURVEY §5 —
+        device-time attribution for the batched verify dispatches)."""
+        self._require_unsafe()
+        import jax
+
+        jax.profiler.start_trace(dir)
+        return {"tracing": True, "dir": dir}
+
+    def unsafe_stop_profiler(self) -> dict:
+        self._require_unsafe()
+        import jax
+
+        jax.profiler.stop_trace()
+        return {"tracing": False}
+
     def abci_info(self) -> dict:
         res = self.node.proxy_app.query.info_sync(abci.RequestInfo())
         return {

@@ -1,0 +1,107 @@
+"""ABCI over gRPC + the gRPC BroadcastAPI (ref: abci/client/grpc_client.go,
+abci/server/grpc_server.go, rpc/grpc/api.go).
+"""
+
+import os
+
+import pytest
+
+grpc = pytest.importorskip("grpc")
+
+from tendermint_tpu.abci import types as abci
+from tendermint_tpu.abci.examples.kvstore import KVStoreApp
+from tendermint_tpu.abci.grpc import (
+    BroadcastAPIServer,
+    GRPCClient,
+    GRPCServer,
+    broadcast_tx_via_grpc,
+)
+
+from tests.consensus_harness import wait_for
+
+
+class TestABCIOverGRPC:
+    @pytest.fixture()
+    def pair(self):
+        srv = GRPCServer("127.0.0.1:0", KVStoreApp())
+        srv.start()
+        client = GRPCClient(f"127.0.0.1:{srv.bound_port}")
+        client.start()
+        yield client
+        client.stop()
+        srv.stop()
+
+    def test_echo_info(self, pair):
+        res = pair.echo_sync(abci.RequestEcho(message="over-grpc"))
+        assert res.message == "over-grpc"
+        info = pair.info_sync(abci.RequestInfo())
+        assert info.last_block_height == 0
+
+    def test_deliver_commit_query_roundtrip(self, pair):
+        assert pair.deliver_tx_sync(abci.RequestDeliverTx(tx=b"g=h")).code == 0
+        commit = pair.commit_sync(abci.RequestCommit())
+        assert commit.data
+        q = pair.query_sync(abci.RequestQuery(data=b"g", path="/store"))
+        assert q.value == b"h"
+
+    def test_check_tx_and_flush(self, pair):
+        assert pair.check_tx_sync(abci.RequestCheckTx(tx=b"x=1")).code == 0
+        pair.flush_sync()
+
+    def test_multi_app_conn_over_grpc(self):
+        """The node's proxy layer speaks gRPC when given grpc:// addresses."""
+        from tendermint_tpu.proxy.app_conn import MultiAppConn, RemoteClientCreator
+
+        srv = GRPCServer("127.0.0.1:0", KVStoreApp())
+        srv.start()
+        conn = MultiAppConn(RemoteClientCreator(f"grpc://127.0.0.1:{srv.bound_port}"))
+        conn.start()
+        try:
+            res = conn.query.info_sync(abci.RequestInfo())
+            assert res.version == "0.1.0"
+        finally:
+            conn.stop()
+            srv.stop()
+
+
+class TestBroadcastAPI:
+    def test_grpc_broadcast_tx_commits(self, tmp_path):
+        from tendermint_tpu.config.config import default_config, test_config
+        from tendermint_tpu.node.node import Node
+        from tendermint_tpu.privval.file_pv import FilePV
+        from tendermint_tpu.types import GenesisDoc, GenesisValidator
+
+        home = str(tmp_path / "n")
+        cfg = default_config()
+        cfg.set_root(home)
+        cfg.base.proxy_app = "kvstore"
+        cfg.base.db_backend = "memdb"
+        cfg.rpc.laddr = ""
+        cfg.rpc.grpc_laddr = "tcp://127.0.0.1:0"
+        cfg.p2p.laddr = ""
+        cfg.consensus = test_config().consensus
+        cfg.consensus.wal_path = ""
+        os.makedirs(os.path.join(home, "config"), exist_ok=True)
+        pv = FilePV.generate(os.path.join(home, "config", "pv.json"))
+        doc = GenesisDoc(
+            chain_id="grpc-chain",
+            genesis_time_ns=1_700_000_000_000_000_000,
+            validators=[GenesisValidator(pv.get_pub_key(), 10)],
+        )
+        doc.validate_and_complete()
+        node = Node(cfg, priv_validator=pv, genesis_doc=doc)
+        node.start()
+        try:
+            res = broadcast_tx_via_grpc(
+                f"127.0.0.1:{node.grpc_broadcast.bound_port}", b"grpc=yes"
+            )
+            assert res["check_tx"]["code"] == 0
+            def committed():
+                for h in range(1, node.block_store.height() + 1):
+                    blk = node.block_store.load_block(h)
+                    if blk and b"grpc=yes" in [bytes(t) for t in blk.data.txs]:
+                        return True
+                return False
+            assert wait_for(committed, timeout=30)
+        finally:
+            node.stop()

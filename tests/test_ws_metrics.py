@@ -85,6 +85,7 @@ def live_node(tmp_path):
     cfg.consensus = test_config().consensus
     cfg.consensus.wal_path = ""
     cfg.instrumentation.prometheus = True
+    cfg.rpc.unsafe = True
     os.makedirs(os.path.join(home, "config"), exist_ok=True)
     pv = FilePV.generate(os.path.join(home, "config", "pv.json"))
     doc = GenesisDoc(
@@ -209,3 +210,25 @@ class TestPrometheusMetrics:
             if l.startswith("tendermint_consensus_height ")
         )
         assert float(height_line.split()[-1]) >= 1
+
+
+class TestDebugRoutes:
+    def test_unsafe_dump_threads(self, live_node):
+        status, body = _rpc_get(live_node, "/unsafe_dump_threads")
+        assert status == 200
+        import json as _json
+
+        out = _json.loads(body)["result"]
+        assert out["n_threads"] >= 3
+        assert any("consensus" in name.lower() or "MainThread" in name
+                   for name in out["stacks"])
+
+    def test_unsafe_routes_gated(self, live_node):
+        live_node.config.rpc.unsafe = False
+        try:
+            _, body = _rpc_get(live_node, "/unsafe_dump_threads")
+            import json as _json
+
+            assert "error" in _json.loads(body)
+        finally:
+            live_node.config.rpc.unsafe = True
