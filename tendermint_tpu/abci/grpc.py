@@ -61,7 +61,13 @@ class GRPCServer(BaseService):
 
             def handler(request, context):
                 with mtx:
-                    return getattr(self.app, app_method)(request)
+                    try:
+                        return getattr(self.app, app_method)(request)
+                    except Exception as e:
+                        # mirror the socket server (abci/server.py): app
+                        # crashes travel as ResponseException so callers'
+                        # app_err accounting engages on every transport
+                        return abci.ResponseException(error=str(e))
 
             return handler
 
@@ -198,24 +204,23 @@ class BroadcastAPIServer(BaseService):
         def ping(request, context):
             return b"{}"
 
-        def broadcast_tx(request, context):
-            from tendermint_tpu.mempool.mempool import MempoolError
+        import base64
 
+        from tendermint_tpu.rpc.core.env import RPCEnv, RPCError
+
+        env = RPCEnv(node)
+
+        def broadcast_tx(request, context):
+            # ONE broadcast implementation: delegate to the HTTP route's
+            # handler so the two transports cannot drift
             tx = bytes(json.loads(request)["tx"].encode("latin1"))
-            done: "q.Queue" = q.Queue()
             try:
-                node.mempool.check_tx(tx, callback=done.put)
-            except MempoolError as e:
-                # duplicate/full/oversized: a structured error, matching the
-                # HTTP path's behavior on the same input
+                out = env.broadcast_tx_sync(base64.b64encode(tx).decode())
+            except RPCError as e:
+                return json.dumps({"error": e.message}).encode()
+            except Exception as e:
                 return json.dumps({"error": str(e)}).encode()
-            try:
-                res = done.get(timeout=10)
-            except q.Empty:
-                context.abort(grpc.StatusCode.DEADLINE_EXCEEDED, "CheckTx timeout")
-            return json.dumps(
-                {"check_tx": {"code": res.code, "log": res.log}}
-            ).encode()
+            return json.dumps({"check_tx": out}).encode()
 
         handlers = {
             "Ping": grpc.unary_unary_rpc_method_handler(

@@ -109,6 +109,15 @@ def cmd_version(args) -> int:
     return 0
 
 
+def cmd_probe_upnp(args) -> int:
+    """Probe for a UPnP gateway (commands/probe_upnp.go)."""
+    from tendermint_tpu.p2p.upnp import probe
+
+    caps = probe()
+    print(json.dumps(caps.__dict__, indent=2))
+    return 0
+
+
 def cmd_replay(args, console: bool = False) -> int:
     """Replay the WAL through a fresh consensus state (commands/replay.go)."""
     from tendermint_tpu.consensus.replay_file import run_replay_file
@@ -282,6 +291,7 @@ def main(argv=None) -> int:
         ("show_validator", cmd_show_validator),
         ("gen_node_key", cmd_gen_node_key),
         ("show_node_id", cmd_show_node_id),
+        ("probe_upnp", cmd_probe_upnp),
         ("unsafe_reset_all", cmd_reset_all),
         ("unsafe_reset_priv_validator", cmd_reset_priv_validator),
     ]:

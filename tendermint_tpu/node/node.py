@@ -103,13 +103,19 @@ class Node(BaseService):
 
         self.metrics = NodeMetrics() if config.instrumentation.prometheus else None
 
-        # mempool + evidence
+        # mempool + evidence (optional mempool WAL, mempool.go:223 InitWAL)
+        mempool_wal = None
+        if root and config.mempool.wal_path:
+            from tendermint_tpu.libs.autofile import Group
+
+            mempool_wal = Group(os.path.join(root, config.mempool.wal_path))
         self.mempool = Mempool(
             self.proxy_app.mempool,
             height=state.last_block_height,
             size=config.mempool.size,
             cache_size=config.mempool.cache_size,
             recheck=config.mempool.recheck,
+            wal_group=mempool_wal,
             metrics=self.metrics,
         )
         if config.consensus.wait_for_txs():

@@ -320,16 +320,21 @@ def _scalar_words(x: int) -> np.ndarray:
     return np.frombuffer(x.to_bytes(32, "little"), dtype="<u4").astype(np.uint32)
 
 
-def _bucket(n: int) -> int:
+def _bucket(n: int, mesh=None) -> int:
     """Pad batches to power-of-two buckets (min 32) so the jit cache covers
     every small batch with ONE compilation — the 256-iteration ladder is
-    expensive to compile and padding rows are nearly free to execute."""
+    expensive to compile and padding rows are nearly free to execute.
+    With a mesh, the bucket must also divide across the batch axis."""
     if n <= 4096:
         b = 32
         while b < n:
             b <<= 1
-        return b
-    return ((n + 4095) // 4096) * 4096
+    else:
+        b = ((n + 4095) // 4096) * 4096
+    if mesh is not None:
+        m = int(np.prod(mesh.devices.shape))
+        b = ((b + m - 1) // m) * m
+    return b
 
 
 def verify_batch(
@@ -343,7 +348,7 @@ def verify_batch(
     n = len(pubkeys)
     if n == 0:
         return np.zeros((0,), dtype=bool)
-    b = _bucket(n)
+    b = _bucket(n, mesh)
 
     qx = np.zeros((b, NLIMB), np.uint32)
     qy = np.zeros((b, NLIMB), np.uint32)

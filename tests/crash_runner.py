@@ -42,6 +42,7 @@ def main() -> int:
     cfg.p2p.laddr = ""  # single-node: no p2p
     cfg.consensus = test_config().consensus  # fast timeouts
     cfg.consensus.wal_path = "data/cs.wal/wal"
+    cfg.mempool.wal_path = "data/mempool.wal"  # exercise the mempool WAL too
 
     os.makedirs(os.path.join(home, "config"), exist_ok=True)
     os.makedirs(os.path.join(home, "data"), exist_ok=True)
