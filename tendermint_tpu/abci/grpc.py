@@ -138,12 +138,18 @@ class GRPCClient(BaseService):
         return self._err
 
     def _call(self, method: str, req: Any) -> Any:
+        from tendermint_tpu.abci.client import ABCIClientError
+
         stub = self._stubs[method]
         try:
             res = stub(req)
         except grpc.RpcError as e:
             self._err = e
             raise
+        if isinstance(res, abci.ResponseException):
+            # app crashed: same structured error SocketClient raises
+            # (abci/client.py:200)
+            raise ABCIClientError(res.error)
         if self._cb is not None:
             self._cb(req, res)
         return res

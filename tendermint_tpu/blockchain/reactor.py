@@ -53,7 +53,11 @@ class WindowVerifyError(Exception):
 
 
 def verify_block_window(
-    state, blocks: List, verifier=None, parts_out: Optional[List] = None
+    state,
+    blocks: List,
+    verifier=None,
+    parts_out: Optional[List] = None,
+    mesh=None,
 ) -> Tuple[int, Optional[WindowVerifyError]]:
     """Verify commits for blocks[0..n-2] (block i's commit is
     blocks[i+1].last_commit, signed by the valset whose hash block i carries
@@ -62,6 +66,11 @@ def verify_block_window(
     Per-precommit validity rules + power collection are shared with the
     single-commit path (ValidatorSet.collect_commit_sigs) so the two
     verifiers cannot drift apart.
+
+    With ``mesh`` (and an all-ed25519 valset) the window dispatches through
+    parallel/commit_verify: the (heights × validators) signature tensor is
+    sharded over the 2-D device mesh and the quorum tallies ride the mesh as
+    reductions — the multi-chip path of SURVEY §5.
 
     Returns (n_verified, err): the first n_verified blocks' commits are
     fully verified; err is set if block n_verified is *invalid* (vs merely
@@ -76,6 +85,9 @@ def verify_block_window(
     n = len(blocks) - 1
     if n <= 0:
         return 0, None
+
+    if mesh is not None:
+        return _verify_window_sharded(state, blocks, mesh, parts_out, verifier)
 
     # 1. host prechecks + truncation at the first valset change
     usable = 0
@@ -136,6 +148,89 @@ def verify_block_window(
     return usable, structural
 
 
+def _verify_window_sharded(
+    state, blocks: List, mesh, parts_out: Optional[List], verifier=None
+) -> Tuple[int, Optional[WindowVerifyError]]:
+    """The mesh path: pack a (heights × validators) tensor and verify+tally
+    it through parallel/commit_verify (ed25519 valsets; a mixed-key set
+    falls back to the flat batch, keeping the caller's verifier)."""
+    from tendermint_tpu.crypto.keys import PubKeyEd25519
+    from tendermint_tpu.parallel import commit_verify as cv
+    from tendermint_tpu.types.validator_set import CommitError
+
+    valset = state.validators
+    chain_id = state.chain_id
+    n = len(blocks) - 1
+    if any(not isinstance(v.pub_key, PubKeyEd25519) for v in valset.validators):
+        return verify_block_window(
+            state, blocks, verifier=verifier, parts_out=parts_out
+        )
+
+    usable = 0
+    structural: Optional[WindowVerifyError] = None
+    votes_rows: List[list] = []
+    power_rows: List[list] = []
+    local_parts: List = []
+    for i in range(n):
+        block, next_block = blocks[i], blocks[i + 1]
+        if block.header.validators_hash != valset.hash():
+            if i == 0:
+                structural = WindowVerifyError(0, "wrong validators_hash")
+            break
+        commit = next_block.last_commit
+        parts = block.make_part_set()
+        block_id = BlockID(hash=block.hash(), parts_header=parts.header())
+        try:
+            # the ONE home of the per-precommit rules; its aligned outputs
+            # (non-nil precommits in index order) are reused below
+            pubkeys, msgs, sigs, powers = valset.collect_commit_sigs(
+                chain_id, block_id, block.height, commit
+            )
+        except CommitError as e:
+            structural = WindowVerifyError(i, str(e))
+            break
+        vrow, prow = [], []
+        j = 0
+        for pc in commit.precommits:
+            if pc is None:
+                vrow.append(None)
+                prow.append(0)
+            else:
+                vrow.append((pubkeys[j].bytes(), msgs[j], sigs[j]))
+                prow.append(powers[j])
+                j += 1
+        votes_rows.append(vrow)
+        power_rows.append(prow)
+        local_parts.append(parts)
+        usable += 1
+
+    if usable == 0:
+        return 0, structural
+
+    win = cv.pack_commit_window(votes_rows, power_rows)
+    ok_hv, _tally, committed = cv.verify_commit_window(
+        win, valset.total_voting_power(), mesh=mesh
+    )
+    present_vote = np.zeros(win.shape, dtype=bool)
+    for h, row in enumerate(votes_rows):
+        for v, item in enumerate(row):
+            present_vote[h, v] = item is not None
+    for i in range(usable):
+        # any invalid signature fails the whole commit (verify_commit parity);
+        # win.present excludes host-precheck failures, which are failures too
+        if bool((present_vote[i] & ~ok_hv[i]).any()):
+            if parts_out is not None:
+                parts_out.extend(local_parts[:i])
+            return i, WindowVerifyError(i, "invalid signature in commit")
+        if not bool(committed[i]):
+            if parts_out is not None:
+                parts_out.extend(local_parts[:i])
+            return i, WindowVerifyError(i, "insufficient voting power")
+    if parts_out is not None:
+        parts_out.extend(local_parts[:usable])
+    return usable, structural
+
+
 class BlockchainReactor(Reactor):
     def __init__(
         self,
@@ -146,6 +241,7 @@ class BlockchainReactor(Reactor):
         consensus_reactor=None,  # .switch_to_consensus(state, n) when caught up
         verifier=None,  # BatchVerifier for the window dispatches
         verify_window: int = VERIFY_WINDOW,
+        mesh=None,  # device mesh: shard windows via parallel/commit_verify
     ):
         super().__init__(name="BlockchainReactor")
         self.initial_state = state
@@ -156,6 +252,7 @@ class BlockchainReactor(Reactor):
         self.consensus_reactor = consensus_reactor
         self.verifier = verifier
         self.verify_window = verify_window
+        self.mesh = mesh
         self.pool = BlockPool(
             start_height=self.store.height() + 1,
             request_cb=self._send_block_request,
@@ -265,7 +362,8 @@ class BlockchainReactor(Reactor):
             return
         parts_list: list = []
         n_ok, err = verify_block_window(
-            self.state, blocks, verifier=self.verifier, parts_out=parts_list
+            self.state, blocks, verifier=self.verifier, parts_out=parts_list,
+            mesh=self.mesh,
         )
         for i in range(n_ok):
             self._trusted_commit_heights.add(blocks[i].height)

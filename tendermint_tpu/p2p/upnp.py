@@ -78,7 +78,15 @@ def _soap(control_url: str, action: str, body_xml: str = "") -> Optional[str]:
 
 def probe(timeout: float = 3.0) -> UPNPCapabilities:
     """Full capability probe (probe.go Probe): discovery → device description
-    → external IP → test port mapping (add + delete)."""
+    → external IP → test port mapping (add + delete). Never raises — every
+    failure lands in .error."""
+    try:
+        return _probe(timeout)
+    except Exception as e:
+        return UPNPCapabilities(error=f"probe failed: {e}")
+
+
+def _probe(timeout: float) -> UPNPCapabilities:
     caps = UPNPCapabilities()
     location = discover(timeout)
     if location is None:
@@ -101,10 +109,15 @@ def probe(timeout: float = 3.0) -> UPNPCapabilities:
     if not m:
         caps.error = "gateway exposes no WANIPConnection service"
         return caps
-    base = location.split("/", 3)
     control = m.group(1)
-    if control.startswith("/"):
-        control = f"{base[0]}//{base[2]}{control}"
+    if not control.startswith("http"):
+        # resolve relative control URLs against <URLBase> or the location
+        base_m = re.search(r"<URLBase>([^<]+)</URLBase>", desc)
+        base = (base_m.group(1) if base_m else location).rstrip("/")
+        if not control.startswith("/"):
+            control = "/" + control
+        parts = base.split("/", 3)
+        control = f"{parts[0]}//{parts[2]}{control}"
     out = _soap(control, "GetExternalIPAddress")
     if out:
         ip = re.search(r"<NewExternalIPAddress>([^<]*)<", out)

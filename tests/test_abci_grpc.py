@@ -105,3 +105,25 @@ class TestBroadcastAPI:
             assert wait_for(committed, timeout=30)
         finally:
             node.stop()
+
+
+class TestAppCrashOverGRPC:
+    def test_app_exception_raises_abci_client_error(self):
+        from tendermint_tpu.abci.client import ABCIClientError
+
+        class CrashyApp(KVStoreApp):
+            def deliver_tx(self, req):
+                raise RuntimeError("app exploded")
+
+        srv = GRPCServer("127.0.0.1:0", CrashyApp())
+        srv.start()
+        client = GRPCClient(f"127.0.0.1:{srv.bound_port}")
+        client.start()
+        try:
+            with pytest.raises(ABCIClientError, match="app exploded"):
+                client.deliver_tx_sync(abci.RequestDeliverTx(tx=b"x"))
+            # the connection stays usable after an app error
+            assert client.echo_sync(abci.RequestEcho(message="ok")).message == "ok"
+        finally:
+            client.stop()
+            srv.stop()

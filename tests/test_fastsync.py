@@ -297,3 +297,53 @@ class TestFastSyncIntegration:
                 if sw.is_running:
                     sw.stop()
             prod_bus.stop()
+
+
+class TestVerifyBlockWindowSharded:
+    """The mesh path: the same window flows through parallel/commit_verify,
+    sharded (heights × validators) over the virtual 8-device mesh — the
+    multi-chip production path fast sync runs with `mesh=` configured."""
+
+    @pytest.fixture(scope="class")
+    def mesh(self):
+        import jax
+        import numpy as np
+        from jax.sharding import Mesh
+
+        devs = np.array(jax.devices("cpu"))
+        if len(devs) < 8:
+            pytest.skip("needs 8 virtual devices")
+        return Mesh(devs[:8].reshape(2, 4), ("height", "val"))
+
+    @pytest.fixture(scope="class")
+    def fx(self):
+        return build_chain(n_vals=4, n_heights=10, chain_id="vbw-mesh")
+
+    def test_matches_flat_path_on_valid_chain(self, fx, mesh):
+        st = state_from_genesis(fx.genesis)
+        blocks = [fx.block_store.load_block(h) for h in range(1, 11)]
+        parts_flat, parts_mesh = [], []
+        flat = verify_block_window(st, blocks, parts_out=parts_flat)
+        sharded = verify_block_window(st, blocks, parts_out=parts_mesh, mesh=mesh)
+        assert flat[0] == sharded[0] == 9
+        assert flat[1] is None and sharded[1] is None
+        assert [p.header() for p in parts_flat] == [p.header() for p in parts_mesh]
+
+    def test_detects_tamper_like_flat_path(self, fx, mesh):
+        st = state_from_genesis(fx.genesis)
+        blocks = [fx.block_store.load_block(h) for h in range(1, 11)]
+        pc = blocks[4].last_commit.precommits[1]
+        blocks[4].last_commit.precommits[1] = dataclasses.replace(
+            pc, signature=b"\x00" * 64
+        )
+        n_ok, err = verify_block_window(st, blocks, mesh=mesh)
+        assert n_ok == 3 and err is not None and err.bad_index == 3
+
+    def test_quorum_failure_detected(self, fx, mesh):
+        st = state_from_genesis(fx.genesis)
+        blocks = [fx.block_store.load_block(h) for h in range(1, 11)]
+        pcs = blocks[6].last_commit.precommits
+        pcs[0] = None
+        pcs[1] = None
+        n_ok, err = verify_block_window(st, blocks, mesh=mesh)
+        assert n_ok == 5 and err is not None and "voting power" in str(err)
