@@ -390,12 +390,18 @@ def verify_batch(
             rn_ok[i] = True
 
     kernel = _compiled_kernel(b, mesh)
-    args = [jnp.asarray(a) for a in (qx, qy, u1w, u2w, rl, rnl, rn_ok)]
+    host = (qx, qy, u1w, u2w, rl, rnl, rn_ok)
     if mesh is not None:
+        # device_put the *numpy* arrays straight onto the mesh sharding: an
+        # intermediate jnp.asarray would commit them to the default backend
+        # (possibly a real TPU) even though the mesh lives on CPU devices —
+        # the round-3 multichip dryrun regression.
         from jax.sharding import NamedSharding, PartitionSpec as PS
 
         sh = NamedSharding(mesh, PS(mesh.axis_names[0]))
-        args = [jax.device_put(a, sh) for a in args]
+        args = [jax.device_put(a, sh) for a in host]
+    else:
+        args = [jnp.asarray(a) for a in host]
     ok = np.asarray(kernel(*args))[:n]
 
     f = forced[:n]

@@ -122,3 +122,54 @@ class TestGraftEntry:
 
         n = min(8, len(jax.devices()))
         ge.dryrun_multichip(n)
+
+    def test_mesh_dispatch_hermetic(self, monkeypatch):
+        """Regression for the round-3 dryrun failure: a mesh-pinned dispatch
+        must never place a buffer off the mesh (an uncommitted jnp.asarray
+        would land on the default device — on the driver, the real TPU).
+
+        Placement is intercepted at CREATION time (wrapping jnp.asarray and
+        jax.device_put and holding references) — a post-hoc live_arrays()
+        scan cannot see intermediates that are freed before the call returns.
+        """
+        import hashlib
+
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import Mesh
+
+        devs = jax.devices()
+        if len(devs) < 8:
+            pytest.skip("needs 8 virtual devices")
+        # mesh deliberately EXCLUDES the default device devs[0]
+        off_default = np.array(devs[4:8])
+        mesh = Mesh(off_default, ("batch",))
+
+        created = []
+        real_asarray, real_device_put = jnp.asarray, jax.device_put
+
+        def record(out):
+            if isinstance(out, jax.Array) and not isinstance(out, jax.core.Tracer):
+                created.append(out)
+            return out
+
+        monkeypatch.setattr(jnp, "asarray", lambda *a, **k: record(real_asarray(*a, **k)))
+        monkeypatch.setattr(
+            jax, "device_put", lambda *a, **k: record(real_device_put(*a, **k))
+        )
+
+        from tendermint_tpu.crypto import secp256k1 as s
+        from tendermint_tpu.ops import secp256k1_verify as sk
+
+        pubs, digs, sigs = [], [], []
+        for i in range(4):
+            priv = s.gen_privkey(bytes([i + 1]) * 32)
+            pubs.append(s.pubkey_compressed(priv))
+            digs.append(hashlib.sha256(b"hermetic-%d" % i).digest())
+            sigs.append(s.sign(priv, digs[-1]))
+        ok = sk.verify_batch(pubs, digs, sigs, mesh=mesh)
+        assert ok.all()
+        mesh_devs = set(off_default.tolist())
+        stray = [a for a in created if not set(a.devices()) <= mesh_devs]
+        assert not stray, [(a.shape, a.devices()) for a in stray]
+        assert created, "interceptor saw no placements — wiring broken"
