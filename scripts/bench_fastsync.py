@@ -9,7 +9,11 @@ Measures the verify→apply pipeline blocks/s on a pre-built signed chain:
     trusted commits.
 
 Usage: python scripts/bench_fastsync.py [n_blocks] [n_vals] [window]
+       python scripts/bench_fastsync.py [n_blocks] [n_vals] --sweep
 Prints one JSON line: {"metric": "fastsync_replay", "value": blocks/s, ...}
+--sweep instead re-runs the verify+apply pipeline over a ladder of window
+sizes and prints one JSON line per window (how VERIFY_WINDOW's default was
+chosen — blockchain/reactor.py:46).
 """
 
 import json
@@ -21,7 +25,11 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 N_BLOCKS = int(sys.argv[1]) if len(sys.argv) > 1 else 2048
 N_VALS = int(sys.argv[2]) if len(sys.argv) > 2 else 64
-WINDOW = int(sys.argv[3]) if len(sys.argv) > 3 else 512
+SWEEP = "--sweep" in sys.argv
+WINDOW = (
+    int(sys.argv[3]) if len(sys.argv) > 3 and sys.argv[3] != "--sweep" else 512
+)
+SWEEP_WINDOWS = [16, 64, 128, 256, 512, 1024]
 BASELINE_SAMPLE_BLOCKS = 64  # serial blocks to time (extrapolated)
 
 
@@ -85,45 +93,70 @@ def main():
     )
 
     # --- ours: windowed batched verify + apply ---
-    try:
-        verifier = TPUBatchVerifier()
-    except Exception:
+    # TM_BATCH_VERIFIER=host skips device construction entirely (a dead
+    # TPU tunnel hangs backend discovery, not errors)
+    if os.environ.get("TM_BATCH_VERIFIER", "").lower() == "host":
         verifier = HostBatchVerifier()
-    st, block_exec = _fresh_executor(fx.genesis)
+    else:
+        try:
+            verifier = TPUBatchVerifier()
+        except Exception:
+            verifier = HostBatchVerifier()
+
+    def run_pipeline(window_size: int) -> float:
+        st, block_exec = _fresh_executor(fx.genesis)
+        t0 = time.perf_counter()
+        applied = 0
+        pos = 0
+        while pos < N_BLOCKS - 1:
+            window = blocks[pos : pos + window_size + 1]
+            parts_list = []
+            n_ok, err = verify_block_window(
+                st, window, verifier=verifier, parts_out=parts_list
+            )
+            if err is not None or n_ok == 0:
+                raise SystemExit(f"verification failed at {pos}: {err}")
+            for i in range(n_ok):
+                block = window[i]
+                block_id = BlockID(
+                    hash=block.hash(), parts_header=parts_list[i].header()
+                )
+                st = block_exec.apply_block(
+                    st, block_id, block, trusted_last_commit=True
+                )
+                applied += 1
+            pos += n_ok
+        return applied / (time.perf_counter() - t0)
+
     # warm the device path (compile + upload) on the first window
     verify_block_window(st, blocks[: min(WINDOW, len(blocks))], verifier=verifier)
 
-    t0 = time.perf_counter()
-    applied = 0
-    pos = 0
-    while pos < N_BLOCKS - 1:
-        window = blocks[pos : pos + WINDOW + 1]
-        parts_list = []
-        n_ok, err = verify_block_window(
-            st, window, verifier=verifier, parts_out=parts_list
-        )
-        if err is not None or n_ok == 0:
-            raise SystemExit(f"verification failed at {pos}: {err}")
-        for i in range(n_ok):
-            block = window[i]
-            block_id = BlockID(
-                hash=block.hash(), parts_header=parts_list[i].header()
+    base_rate = N_BLOCKS / baseline_s
+    if SWEEP:
+        for w in SWEEP_WINDOWS:
+            if w >= N_BLOCKS:
+                continue
+            rate = run_pipeline(w)
+            print(
+                json.dumps(
+                    {
+                        "metric": f"fastsync_replay_{N_BLOCKS}x{N_VALS}_w{w}",
+                        "value": round(rate, 1),
+                        "unit": "blocks/s",
+                        "vs_baseline": round(rate / base_rate, 2),
+                    }
+                )
             )
-            st = block_exec.apply_block(
-                st, block_id, block, trusted_last_commit=True
-            )
-            applied += 1
-        pos += n_ok
-    ours_s = time.perf_counter() - t0
-    ours_rate = applied / ours_s
+        return
 
+    ours_rate = run_pipeline(WINDOW)
     print(
         json.dumps(
             {
                 "metric": f"fastsync_replay_{N_BLOCKS}x{N_VALS}",
                 "value": round(ours_rate, 1),
                 "unit": "blocks/s",
-                "vs_baseline": round((N_BLOCKS / baseline_s) and ours_rate / (N_BLOCKS / baseline_s), 2),
+                "vs_baseline": round(ours_rate / base_rate, 2),
             }
         )
     )

@@ -43,13 +43,35 @@ MAX_MSG_SIZE = 104857600  # 100 MB protocol block ceiling (types/params.go:11)
 TRY_SYNC_INTERVAL = 0.01  # reference trySyncTicker 10ms
 STATUS_UPDATE_INTERVAL = 2.0  # reference 10s; shrunk for test nets
 SWITCH_TO_CONSENSUS_INTERVAL = 0.5  # reference 1s
-VERIFY_WINDOW = 64  # heights verified per device dispatch
+# Heights verified per device dispatch. The bench sweep (scripts/
+# bench_fastsync.py --sweep) puts the throughput knee at ~512 heights for
+# small valsets — below that, fixed dispatch latency dominates; above, the
+# batch no longer amortizes. auto_verify_window shrinks it for huge valsets
+# so a window's signature tensor stays within device memory.
+VERIFY_WINDOW = 512
+MAX_WINDOW_SIGS = 512 * 1024  # |window| × |valset| ceiling per dispatch
+
+
+def auto_verify_window(n_validators: int, window: int = VERIFY_WINDOW) -> int:
+    """Window size bounded so window × valset ≤ MAX_WINDOW_SIGS (a 10k-val
+    set still gets ~52-height batches; a 64-val set the full default)."""
+    if n_validators <= 0:
+        return window
+    return max(1, min(window, MAX_WINDOW_SIGS // max(1, n_validators)))
 
 
 class WindowVerifyError(Exception):
     def __init__(self, bad_index: int, reason: str):
         super().__init__(f"block window invalid at offset {bad_index}: {reason}")
         self.bad_index = bad_index
+
+
+class FatalSyncError(Exception):
+    """A block with a valid +2/3 commit failed state validation/application.
+    Retrying can never succeed (the same window would re-verify and re-fail
+    forever — a silent livelock); the reference deliberately panics here
+    (blockchain/reactor.go:327 via ApplyBlock panic). We halt fast sync
+    loudly instead of looping."""
 
 
 def verify_block_window(
@@ -240,7 +262,7 @@ class BlockchainReactor(Reactor):
         fast_sync: bool = True,
         consensus_reactor=None,  # .switch_to_consensus(state, n) when caught up
         verifier=None,  # BatchVerifier for the window dispatches
-        verify_window: int = VERIFY_WINDOW,
+        verify_window: Optional[int] = None,  # None → auto by valset size
         mesh=None,  # device mesh: shard windows via parallel/commit_verify
     ):
         super().__init__(name="BlockchainReactor")
@@ -251,7 +273,11 @@ class BlockchainReactor(Reactor):
         self.fast_sync = fast_sync
         self.consensus_reactor = consensus_reactor
         self.verifier = verifier
-        self.verify_window = verify_window
+        # explicit window is fixed; None → auto-sized per dispatch (the
+        # valset can grow/shrink DURING sync, and the MAX_WINDOW_SIGS
+        # device-memory ceiling must hold for the set actually being
+        # verified, not the one at construction)
+        self._fixed_window = verify_window
         self.mesh = mesh
         self.pool = BlockPool(
             start_height=self.store.height() + 1,
@@ -352,9 +378,26 @@ class BlockchainReactor(Reactor):
                     return
             try:
                 self._try_sync_window()
+            except FatalSyncError:
+                self.logger.error(
+                    "FATAL: fast sync halted — verified block failed to "
+                    "apply; manual intervention required (reference panics "
+                    "here)", exc_info=True,
+                )
+                try:
+                    self.pool.stop()
+                except Exception:
+                    pass
+                return
             except Exception:
                 self.logger.exception("fast sync window failed")
             self._quit.wait(TRY_SYNC_INTERVAL)
+
+    @property
+    def verify_window(self) -> int:
+        if self._fixed_window is not None:
+            return self._fixed_window
+        return auto_verify_window(self.state.validators.size)
 
     def _try_sync_window(self) -> None:
         blocks = self.pool.peek_window(self.verify_window + 1)
@@ -381,12 +424,26 @@ class BlockchainReactor(Reactor):
             parts = parts_list[i]
             block_id = BlockID(hash=block.hash(), parts_header=parts.header())
             self.store.save_block(block, parts, blocks[i + 1].last_commit)
-            # the first synced block's own LastCommit predates our batches —
-            # its membership check below is False, forcing the full verify
-            self.state = self.block_exec.apply_block(
-                self.state, block_id, block,
-                trusted_last_commit=block.height - 1 in self._trusted_commit_heights,
-            )
+            try:
+                # the first synced block's own LastCommit predates our
+                # batches — its membership check below is False, forcing
+                # the full verify
+                self.state = self.block_exec.apply_block(
+                    self.state, block_id, block,
+                    trusted_last_commit=block.height - 1
+                    in self._trusted_commit_heights,
+                )
+            except Exception as e:
+                # commit was valid but the block won't apply: punish the
+                # supplier for the record, then halt — retrying loops forever
+                peer_id = self.pool.redo_request(block.height)
+                if peer_id:
+                    self._stop_peer_by_id(
+                        peer_id, f"sent unappliable block {block.height}"
+                    )
+                raise FatalSyncError(
+                    f"verified block {block.height} failed to apply: {e}"
+                ) from e
             self.pool.pop_first()
             self.blocks_synced += 1
             self._trusted_commit_heights.discard(block.height - 2)

@@ -12,18 +12,29 @@ TPU batched-hash kernel in ops/). Proof verification matches this layout.
 from __future__ import annotations
 
 import hashlib
+import os
 from dataclasses import dataclass, field
 from typing import List, Optional, Sequence
 
 _LEAF_PREFIX = b"\x00"
 _INNER_PREFIX = b"\x01"
 
+# Native SHA-256/merkle (crypto/_hash_native.c, SHA-NI when the CPU has it) —
+# the whole tree walks in one C call instead of 2n Python-level hash calls.
+# Pure-Python definitions below remain the reference implementation/fallback.
+from tendermint_tpu.encoding.native import load_ext as _load_ext
+
+_native = _load_ext(
+    os.path.join(os.path.dirname(os.path.abspath(__file__)), "_hash_native.c"),
+    "tendermint_tpu.crypto._hash_native",
+)
+
 
 def _hash(data: bytes) -> bytes:
     return hashlib.sha256(data).digest()
 
 
-def leaf_hash(leaf: bytes) -> bytes:
+def _py_leaf_hash(leaf: bytes) -> bytes:
     return _hash(_LEAF_PREFIX + leaf)
 
 
@@ -39,7 +50,7 @@ def _split_point(n: int) -> int:
     return k
 
 
-def hash_from_byte_slices(items: Sequence[bytes]) -> bytes:
+def _py_hash_from_byte_slices(items: Sequence[bytes]) -> bytes:
     """Merkle root of a list of byte slices (cf. SimpleHashFromByteSlices)."""
     n = len(items)
     if n == 0:
@@ -47,7 +58,17 @@ def hash_from_byte_slices(items: Sequence[bytes]) -> bytes:
     if n == 1:
         return leaf_hash(items[0])
     k = _split_point(n)
-    return inner_hash(hash_from_byte_slices(items[:k]), hash_from_byte_slices(items[k:]))
+    return inner_hash(
+        _py_hash_from_byte_slices(items[:k]), _py_hash_from_byte_slices(items[k:])
+    )
+
+
+if _native is not None:
+    leaf_hash = _native.leaf_hash
+    hash_from_byte_slices = _native.merkle_root
+else:
+    leaf_hash = _py_leaf_hash
+    hash_from_byte_slices = _py_hash_from_byte_slices
 
 
 def hash_from_map(m: dict) -> bytes:
@@ -126,8 +147,18 @@ def _compute_from_aunts(
 
 def proofs_from_byte_slices(items: Sequence[bytes]) -> tuple[bytes, List[SimpleProof]]:
     """Build root + per-leaf proofs (cf. SimpleProofsFromByteSlices)."""
-    n = len(items)
-    lhs = [leaf_hash(it) for it in items]
+    lhs = (
+        _native.leaf_hashes(list(items))
+        if _native is not None
+        else [leaf_hash(it) for it in items]
+    )
+    return proofs_from_leaf_hashes(lhs)
+
+
+def proofs_from_leaf_hashes(lhs: Sequence[bytes]) -> tuple[bytes, List[SimpleProof]]:
+    """Root + proofs when the leaf hashes are already computed (the part-set
+    path hashes chunks natively straight off the block buffer)."""
+    n = len(lhs)
     proofs = [SimpleProof(total=n, index=i, leaf_hash=lhs[i]) for i in range(n)]
 
     def build(lo: int, hi: int) -> bytes:

@@ -79,15 +79,20 @@ static void writer_dealloc(WriterObject *self)
 
 static PyObject *writer_uvarint(WriterObject *self, PyObject *arg)
 {
-    int overflow = 0;
-    long long v = PyLong_AsLongLongAndOverflow(arg, &overflow);
-    if (v == -1 && PyErr_Occurred())
-        return NULL;
-    if (overflow || v < 0) {
-        PyErr_SetString(PyExc_ValueError, "uvarint must be non-negative");
+    /* Accept the FULL uint64 domain [0, 2^64): wire uvarints are uint64 and
+     * the pure-Python writer must accept exactly the same range — divergent
+     * writer acceptance between codec backends is a network-split hazard. */
+    uint64_t v = PyLong_AsUnsignedLongLong(arg);
+    if (v == (uint64_t)-1 && PyErr_Occurred()) {
+        if (PyErr_ExceptionMatches(PyExc_OverflowError) ||
+            PyErr_ExceptionMatches(PyExc_TypeError)) {
+            PyErr_Clear();
+            PyErr_SetString(PyExc_ValueError,
+                            "uvarint must be in [0, 2^64)");
+        }
         return NULL;
     }
-    if (writer_put_uvarint(self, (uint64_t)v) < 0)
+    if (writer_put_uvarint(self, v) < 0)
         return NULL;
     Py_INCREF(self);
     return (PyObject *)self;
@@ -265,7 +270,10 @@ static void reader_dealloc(ReaderObject *self)
 static int reader_get_uvarint(ReaderObject *self, uint64_t *out)
 {
     /* wire uvarints are uint64; larger is malformed and must be rejected
-     * exactly like the pure-Python reader (and shifting by >=64 is UB) */
+     * exactly like the pure-Python reader (and shifting by >=64 is UB).
+     * Non-minimal encodings (trailing zero continuation bytes) are also
+     * rejected: decode-time wire-span hash caching means two encodings of
+     * one value would hash one logical structure two ways. */
     uint64_t v = 0;
     int shift = 0;
     while (1) {
@@ -276,6 +284,10 @@ static int reader_get_uvarint(ReaderObject *self, uint64_t *out)
         uint8_t b = self->data[self->pos++];
         if (shift == 63 && (b & 0x7F) > 1) {
             PyErr_SetString(PyExc_ValueError, "uvarint overflows uint64");
+            return -1;
+        }
+        if (shift > 0 && b == 0) {
+            PyErr_SetString(PyExc_ValueError, "non-minimal uvarint");
             return -1;
         }
         v |= ((uint64_t)(b & 0x7F)) << shift;
@@ -385,6 +397,27 @@ static PyObject *reader_at_end(ReaderObject *self, PyObject *noarg)
     return PyBool_FromLong(self->pos >= self->len);
 }
 
+static PyObject *reader_tell(ReaderObject *self, PyObject *noarg)
+{
+    return PyLong_FromSsize_t(self->pos);
+}
+
+static PyObject *reader_span(ReaderObject *self, PyObject *arg)
+{
+    /* bytes from a previously tell()'d offset up to the current position —
+     * lets decoders capture the exact wire span of a sub-structure without
+     * re-encoding it (vote/commit hash caching on the fast-sync hot path) */
+    Py_ssize_t start = PyLong_AsSsize_t(arg);
+    if (start == -1 && PyErr_Occurred())
+        return NULL;
+    if (start < 0 || start > self->pos) {
+        PyErr_SetString(PyExc_ValueError, "span start out of range");
+        return NULL;
+    }
+    return PyBytes_FromStringAndSize((const char *)self->data + start,
+                                     self->pos - start);
+}
+
 static PyMethodDef reader_methods[] = {
     {"uvarint", (PyCFunction)reader_uvarint, METH_NOARGS, NULL},
     {"svarint", (PyCFunction)reader_svarint, METH_NOARGS, NULL},
@@ -395,6 +428,8 @@ static PyMethodDef reader_methods[] = {
     {"raw", (PyCFunction)reader_raw, METH_O, NULL},
     {"remaining", (PyCFunction)reader_remaining, METH_NOARGS, NULL},
     {"at_end", (PyCFunction)reader_at_end, METH_NOARGS, NULL},
+    {"tell", (PyCFunction)reader_tell, METH_NOARGS, NULL},
+    {"span", (PyCFunction)reader_span, METH_O, NULL},
     {NULL, NULL, 0, NULL},
 };
 

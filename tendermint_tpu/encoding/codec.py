@@ -36,8 +36,10 @@ def write_uvarint(buf: io.BytesIO, n: int) -> None:
 def encode_uvarint(n: int) -> bytes:
     if 0 <= n < 0x80:
         return _B1[n]
-    if n < 0:
-        raise ValueError("uvarint must be non-negative")
+    if n < 0 or n >= 1 << 64:
+        # wire uvarints are uint64 — both codec backends must accept exactly
+        # [0, 2^64) or writers could emit frames peers reject
+        raise ValueError("uvarint must be in [0, 2^64)")
     out = bytearray()
     while True:
         b = n & 0x7F
@@ -52,7 +54,12 @@ def encode_uvarint(n: int) -> bytes:
 def read_uvarint(buf: io.BytesIO) -> int:
     """Wire uvarints are uint64 — anything larger is malformed input and
     must be REJECTED identically by this and the native reader (divergent
-    acceptance between codec backends would split the network)."""
+    acceptance between codec backends would split the network).
+
+    Non-MINIMAL encodings (padded with trailing zero continuation bytes,
+    e.g. 0xC0 0x00 for 64) are also rejected: decoders capture wire spans
+    for hash caching (Vote/Block decode), so two encodings of the same
+    value would let an attacker make one logical structure hash two ways."""
     shift = 0
     out = 0
     while True:
@@ -62,6 +69,8 @@ def read_uvarint(buf: io.BytesIO) -> int:
         b = ch[0]
         if shift == 63 and b > 1:
             raise ValueError("uvarint overflows uint64")
+        if shift > 0 and b == 0:
+            raise ValueError("non-minimal uvarint")
         out |= (b & 0x7F) << shift
         if not (b & 0x80):
             return out
@@ -146,7 +155,7 @@ class _PyWriter:
         if 0 <= n < 0x80:
             buf.append(n)
             return self
-        buf += encode_uvarint(n)
+        buf += encode_uvarint(n)  # rejects outside [0, 2^64)
         return self
 
     def svarint(self, n: int) -> "Writer":
@@ -213,6 +222,19 @@ class _PyReader:
 
     def at_end(self) -> bool:
         return self.remaining() == 0
+
+    def tell(self) -> int:
+        return self._buf.tell()
+
+    def span(self, start: int) -> bytes:
+        """Bytes from a previously tell()'d offset to the current position
+        (wire-span capture for decode-time hash caching)."""
+        pos = self._buf.tell()
+        if start < 0 or start > pos:
+            raise ValueError("span start out of range")
+        self._buf.seek(start)
+        out = self._buf.read(pos - start)
+        return out
 
 
 # ---------------------------------------------------------------------------

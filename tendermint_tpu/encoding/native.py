@@ -1,10 +1,10 @@
-"""Build + load the native codec extension (encoding/_codec_native.c).
+"""Build + load native C extensions (encoding/_codec_native.c and friends).
 
 Compiled lazily on first import (cc against the running interpreter's
 headers, cached next to the source, rebuilt when the .c changes); any
-failure falls back to the pure-Python codec — behavior is identical, only
-the constant factor changes. Set TM_NO_NATIVE_CODEC=1 to force the
-fallback (tests exercise both paths).
+failure falls back to the pure-Python implementation — behavior is
+identical, only the constant factor changes. Set TM_NO_NATIVE_CODEC=1 to
+force the fallback (tests exercise both paths).
 """
 
 from __future__ import annotations
@@ -16,44 +16,53 @@ import sys
 import sysconfig
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
-_SRC = os.path.join(_HERE, "_codec_native.c")
-_SO = os.path.join(
-    _HERE, f"_codec_native.{sysconfig.get_config_var('SOABI')}.so"
-)
+_SOABI = sysconfig.get_config_var("SOABI")
 
 
-def _build() -> bool:
+def _build(src: str, so: str, extra_cflags=()) -> bool:
     include = sysconfig.get_paths()["include"]
     cc = os.environ.get("CC", "cc")
     # unique temp path: N processes building concurrently (localnet launch)
     # must not interleave writes into one file — a corrupt .so with a fresh
     # mtime would silently disable the native codec forever
-    tmp = f"{_SO}.{os.getpid()}.tmp"
-    cmd = [cc, "-O2", "-shared", "-fPIC", f"-I{include}", _SRC, "-o", tmp]
+    tmp = f"{so}.{os.getpid()}.tmp"
+    cmd = [cc, "-O2", "-shared", "-fPIC", f"-I{include}", *extra_cflags,
+           src, "-o", tmp]
     try:
         res = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
     except Exception:
         return False
     if res.returncode != 0:
-        sys.stderr.write(f"codec native build failed:\n{res.stderr[-1000:]}\n")
+        sys.stderr.write(
+            f"native build failed ({os.path.basename(src)}):\n"
+            f"{res.stderr[-1000:]}\n"
+        )
         return False
-    os.replace(tmp, _SO)
+    os.replace(tmp, so)
     return True
 
 
-def load():
-    """The compiled module, or None when unavailable."""
+def load_ext(src: str, module_name: str, extra_cflags=()):
+    """Compile (if stale) and import the extension at `src`; None on failure
+    or when TM_NO_NATIVE_CODEC is set."""
     if os.environ.get("TM_NO_NATIVE_CODEC"):
         return None
+    so = os.path.splitext(src)[0] + f".{_SOABI}.so"
     try:
-        if not os.path.exists(_SO) or os.path.getmtime(_SO) < os.path.getmtime(_SRC):
-            if not _build():
+        if not os.path.exists(so) or os.path.getmtime(so) < os.path.getmtime(src):
+            if not _build(src, so, extra_cflags):
                 return None
-        spec = importlib.util.spec_from_file_location(
-            "tendermint_tpu.encoding._codec_native", _SO
-        )
+        spec = importlib.util.spec_from_file_location(module_name, so)
         mod = importlib.util.module_from_spec(spec)
         spec.loader.exec_module(mod)
         return mod
     except Exception:
         return None
+
+
+def load():
+    """The compiled codec module, or None when unavailable."""
+    return load_ext(
+        os.path.join(_HERE, "_codec_native.c"),
+        "tendermint_tpu.encoding._codec_native",
+    )

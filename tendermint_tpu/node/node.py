@@ -181,6 +181,13 @@ class Node(BaseService):
 
         self.node_key = NodeKey.load_or_generate(config.base.node_key_path())
         fast_sync = config.base.fast_sync
+        # Never fast-sync when the only validator is us (node.go:246-252):
+        # there is no one to sync from, and waiting for peers stalls a
+        # freshly initialized single-validator chain forever.
+        if fast_sync and state.validators.size == 1 and self.priv_validator is not None:
+            only_val = state.validators.validators[0]
+            if self.priv_validator.get_pub_key().address() == only_val.address:
+                fast_sync = False
         self.consensus_reactor = ConsensusReactor(
             self.consensus_state, fast_sync=fast_sync
         )
@@ -216,7 +223,9 @@ class Node(BaseService):
                 for s in config.p2p.seeds.split(",")
                 if s.strip()
             ]
-            pex_reactor = PEXReactor(self.addr_book, seeds=seeds)
+            pex_reactor = PEXReactor(
+                self.addr_book, seeds=seeds, seed_mode=config.p2p.seed_mode
+            )
 
         mconfig = MConnConfig(
             send_rate=config.p2p.send_rate,

@@ -178,6 +178,44 @@ class AddrBook:
         n = min(len(addrs), max(1, len(addrs) * 23 // 100), max_count)
         return addrs[:n]
 
+    def get_selection_with_bias(
+        self, new_bias_pct: int = 30, max_count: int = 250
+    ) -> List[NetAddress]:
+        """Selection biased new-vs-old by percentage — what a seed answers
+        crawl requests with (addrbook.go GetSelectionWithBias, used at
+        pex_reactor.go:186 with biasTowardsNewAddrs=30)."""
+        with self._mtx:
+            new = [k.addr for k in self._by_id.values() if k.bucket_type == "new"]
+            old = [k.addr for k in self._by_id.values() if k.bucket_type == "old"]
+        total = len(new) + len(old)
+        if total == 0:
+            return []
+        n = min(total, max(1, total * 23 // 100), max_count)
+        random.shuffle(new)
+        random.shuffle(old)
+        # round the new-portion UP: a bias toward new addrs must survive
+        # tiny selections (n=1 would otherwise always pick old — for a seed
+        # that means answering a crawler with its own address)
+        want_new = min(len(new), -(-n * new_bias_pct // 100))
+        sel = new[:want_new] + old[: n - want_new]
+        if len(sel) < n:  # one pool ran short: top up from the other
+            sel += new[want_new : want_new + n - len(sel)]
+        random.shuffle(sel)
+        return sel
+
+    def list_known(self) -> List[KnownAddress]:
+        """Snapshot of every known address with its attempt timestamps —
+        the seed crawler's work list (addrbook.go ListOfKnownAddresses)."""
+        with self._mtx:
+            return [
+                KnownAddress(
+                    addr=k.addr, src=k.src, attempts=k.attempts,
+                    last_attempt=k.last_attempt, last_success=k.last_success,
+                    bucket_type=k.bucket_type,
+                )
+                for k in self._by_id.values()
+            ]
+
     # -- persistence ---------------------------------------------------------------
     def save(self) -> None:
         if not self._file:

@@ -94,6 +94,29 @@ class HTTPClient:
     def dump_consensus_state(self) -> dict:
         return self.call("dump_consensus_state")
 
+    def consensus_state(self) -> dict:
+        return self.call("consensus_state")
+
+    def consensus_params(self, height: Optional[int] = None) -> dict:
+        return self.call(
+            "consensus_params", **({"height": height} if height else {})
+        )
+
+    def blockchain(self, min_height: int = 0, max_height: int = 0) -> dict:
+        return self.call("blockchain", minHeight=min_height, maxHeight=max_height)
+
+    def block_results(self, height: Optional[int] = None) -> dict:
+        return self.call("block_results", **({"height": height} if height else {}))
+
+    def dial_seeds(self, seeds: list) -> dict:
+        return self.call("dial_seeds", seeds=seeds)
+
+    def dial_peers(self, peers: list, persistent: bool = False) -> dict:
+        return self.call("dial_peers", peers=peers, persistent=persistent)
+
+    def unsafe_flush_mempool(self) -> dict:
+        return self.call("unsafe_flush_mempool")
+
     def unconfirmed_txs(self, limit: int = 30) -> dict:
         return self.call("unconfirmed_txs", limit=limit)
 

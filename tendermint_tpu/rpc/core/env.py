@@ -75,6 +75,135 @@ class RPCEnv:
             },
         }
 
+    BLOCKCHAIN_INFO_LIMIT = 20  # reference blocks.go:60 const limit
+
+    def blockchain(self, minHeight: int = 0, maxHeight: int = 0) -> dict:
+        """Block metas for [minHeight, maxHeight], newest first, capped at 20
+        (ref BlockchainInfo rpc/core/blocks.go:66 + filterMinMax)."""
+        bs = self.node.block_store
+        store_height = bs.height()
+        min_h, max_h = int(minHeight), int(maxHeight)
+        if min_h < 0 or max_h < 0:
+            raise RPCError(-32602, "heights must be non-negative")
+        if min_h == 0:
+            min_h = 1
+        max_h = store_height if max_h == 0 else min(store_height, max_h)
+        min_h = max(min_h, max_h - self.BLOCKCHAIN_INFO_LIMIT + 1)
+        if min_h > max_h:
+            raise RPCError(
+                -32603, f"min height {min_h} can't be greater than max height {max_h}"
+            )
+        metas = []
+        for h in range(max_h, min_h - 1, -1):
+            meta = bs.load_block_meta(h)
+            if meta is None:
+                continue
+            metas.append(
+                {
+                    "block_id": {
+                        "hash": meta.block_id.hash.hex().upper(),
+                        "parts": {
+                            "total": meta.block_id.parts_header.total,
+                            "hash": meta.block_id.parts_header.hash.hex().upper(),
+                        },
+                    },
+                    "header": _header_json(meta.header),
+                }
+            )
+        return {"last_height": store_height, "block_metas": metas}
+
+    def block_results(self, height: Optional[int] = None) -> dict:
+        """ABCI results (DeliverTx, EndBlock) recorded for a height
+        (ref BlockResults rpc/core/blocks.go:353; responses saved per height
+        in the state store like state/store.go:204)."""
+        from tendermint_tpu.state import store as sm_store
+
+        bs = self.node.block_store
+        h = int(height) if height else bs.height()
+        if h < 1 or h > bs.height():
+            raise RPCError(-32603, f"height {h} is not available")
+        try:
+            resp = sm_store.load_abci_responses(self.node.state_db, h)
+        except Exception as e:
+            raise RPCError(-32603, f"no results for height {h}: {e}")
+        end_block = resp.end_block
+        return {
+            "height": h,
+            "results": {
+                "DeliverTx": [_tx_res_json(r) for r in (resp.deliver_tx or [])],
+                "EndBlock": {
+                    "validator_updates": [
+                        {
+                            "pub_key": vu.pub_key.to_json_obj()
+                            if hasattr(vu.pub_key, "to_json_obj")
+                            else _b64(vu.pub_key),
+                            "power": vu.power,
+                        }
+                        for vu in (end_block.validator_updates if end_block else [])
+                    ],
+                    "tags": [
+                        {"key": _b64(kv.key), "value": _b64(kv.value)}
+                        for kv in (end_block.tags if end_block else [])
+                    ],
+                },
+            },
+        }
+
+    def consensus_state(self) -> dict:
+        """Compact live round state — the RoundStateSimple form
+        (ref ConsensusState rpc/core/consensus.go:261)."""
+        cs = self.node.consensus_state
+        rs = cs.get_round_state()
+        votes = None
+        if rs.votes is not None:
+            votes = []
+            for r in range(rs.round + 1):
+                pv = rs.votes.prevotes(r)
+                pc = rs.votes.precommits(r)
+                votes.append(
+                    {
+                        "round": r,
+                        "prevotes_bit_array": str(pv.bit_array()) if pv else "",
+                        "precommits_bit_array": str(pc.bit_array()) if pc else "",
+                    }
+                )
+        proposal_hash = (
+            rs.proposal_block.hash() if rs.proposal_block is not None else None
+        )
+        locked_hash = rs.locked_block.hash() if rs.locked_block is not None else None
+        valid_hash = rs.valid_block.hash() if rs.valid_block is not None else None
+        return {
+            "round_state": {
+                "height/round/step": f"{rs.height}/{rs.round}/{int(rs.step)}",
+                "start_time": rs.start_time,
+                "proposal_block_hash": proposal_hash.hex().upper() if proposal_hash else "",
+                "locked_block_hash": locked_hash.hex().upper() if locked_hash else "",
+                "valid_block_hash": valid_hash.hex().upper() if valid_hash else "",
+                "height_vote_set": votes,
+            }
+        }
+
+    def consensus_params(self, height: Optional[int] = None) -> dict:
+        """Consensus parameters at a height from the state store
+        (ref ConsensusParams rpc/core/consensus.go:299)."""
+        from tendermint_tpu.state import store as sm_store
+
+        h = int(height) if height else self.node.block_store.height() + 1
+        try:
+            params = sm_store.load_consensus_params(self.node.state_db, h)
+        except Exception as e:
+            raise RPCError(-32603, f"no consensus params for height {h}: {e}")
+        return {
+            "block_height": h,
+            "consensus_params": {
+                "block_size": {
+                    "max_bytes": params.block_size.max_bytes,
+                    "max_gas": params.block_size.max_gas,
+                },
+                "evidence": {"max_age": params.evidence.max_age},
+            },
+        }
+
     def commit(self, height: Optional[int] = None) -> dict:
         bs = self.node.block_store
         h = int(height) if height else bs.height()
@@ -311,6 +440,44 @@ class RPCEnv:
         rpc/core/routes.go:43)."""
         if not self.node.config.rpc.unsafe:
             raise RPCError(-32601, "unsafe RPC routes are disabled (rpc.unsafe)")
+
+    @staticmethod
+    def _parse_addr_list(v) -> list:
+        """JSON list or comma-separated string of id@host:port addresses."""
+        if isinstance(v, str):
+            v = [s for s in v.split(",") if s.strip()]
+        return list(v or [])
+
+    def _dial_addrs(self, items, label: str, persistent: bool) -> dict:
+        """Shared body of dial_seeds/dial_peers (ref rpc/core/net.go:42,59)."""
+        self._require_unsafe()
+        from tendermint_tpu.p2p.netaddress import NetAddress
+
+        sw = getattr(self.node, "switch", None)
+        if sw is None:
+            raise RPCError(-32603, "p2p switch not running")
+        items = self._parse_addr_list(items)
+        if not items:
+            raise RPCError(-32602, f"no {label} provided")
+        try:
+            addrs = [NetAddress.parse(s) for s in items]
+        except Exception as e:
+            raise RPCError(-32602, f"bad {label} address: {e}")
+        sw.dial_peers_async(addrs, persistent=persistent)
+        return {"log": f"Dialing {label} in progress. See /net_info for details"}
+
+    def dial_seeds(self, seeds=None) -> dict:
+        return self._dial_addrs(seeds, "seeds", persistent=False)
+
+    def dial_peers(self, peers=None, persistent: bool = False) -> dict:
+        return self._dial_addrs(peers, "peers", persistent=bool(persistent))
+
+    def unsafe_flush_mempool(self) -> dict:
+        """Drop every pending tx (ref UnsafeFlushMempool
+        rpc/core/mempool.go:264, routes.go:47)."""
+        self._require_unsafe()
+        self.node.mempool.flush()
+        return {}
 
     def unsafe_dump_threads(self) -> dict:
         """Stack dump of every live thread — the pprof-goroutine analogue

@@ -227,13 +227,12 @@ def _get_begin_block_validator_info(
 ):
     votes = []
     if block.height > 1:
-        for i in range(last_val_set.size):
-            _, val = last_val_set.get_by_index(i)
-            pc = (
-                block.last_commit.precommits[i]
-                if i < len(block.last_commit.precommits)
-                else None
-            )
+        precommits = block.last_commit.precommits
+        n_pc = len(precommits)
+        # read validators in place — get_by_index's defensive copy is pure
+        # allocation on this per-block loop
+        for i, val in enumerate(last_val_set.validators):
+            pc = precommits[i] if i < n_pc else None
             votes.append(
                 abci.VoteInfo(
                     address=val.address,

@@ -154,12 +154,12 @@ def median_time(commit: Commit, validators: ValidatorSet) -> int:
     Returns unix nanos."""
     weighted: List[Tuple[int, int]] = []  # (time_ns, power)
     total = 0
+    vals = validators.validators
+    n_vals = len(vals)
     for i, pc in enumerate(commit.precommits):
-        if pc is None:
+        if pc is None or i >= n_vals:
             continue
-        _, val = validators.get_by_index(i)
-        if val is None:
-            continue
+        val = vals[i]  # in-place read; get_by_index would copy per vote
         weighted.append((pc.timestamp_ns, val.voting_power))
         total += val.voting_power
     if not weighted:

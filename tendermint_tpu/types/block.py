@@ -269,6 +269,11 @@ class Block:
         self.evidence = evidence
         self.last_commit = last_commit
         self._block_id_hash: Optional[bytes] = None
+        self._marshal_cache: Optional[bytes] = None
+        # decode() marks blocks immutable-by-convention: only those cache
+        # hash/marshal (locally built proposal blocks stay mutable until
+        # sealed — tampering must change the hash)
+        self._immutable = False
 
     @classmethod
     def make_block(
@@ -299,8 +304,15 @@ class Block:
         return self.header.height
 
     def hash(self) -> Optional[bytes]:
+        # memoized for decoded (immutable) blocks: verify, validate_basic
+        # and save each need the block id on the fast-sync apply path
+        if self._block_id_hash is not None:
+            return self._block_id_hash
         self.fill_header()
-        return self.header.hash()
+        h = self.header.hash()
+        if self._immutable and h is not None:
+            self._block_id_hash = h
+        return h
 
     def make_part_set(self, part_size: Optional[int] = None):
         from tendermint_tpu.types.part_set import BLOCK_PART_SIZE_BYTES, PartSet
@@ -335,18 +347,29 @@ class Block:
         self.last_commit.encode(w)
 
     def marshal(self) -> bytes:
+        # decode installs the original wire buffer so a synced block is
+        # never re-marshaled for part-set construction or the store
+        # (reference rehashes per block — blockchain/reactor.go:299, the
+        # SURVEY §3.4 CPU hot spot); locally built blocks re-encode (they
+        # remain mutable until sealed)
+        if self._marshal_cache is not None:
+            return self._marshal_cache
         w = Writer()
         self.encode(w)
         return w.build()
 
     @classmethod
     def decode(cls, r: Reader) -> "Block":
-        return cls(
+        start = r.tell()
+        block = cls(
             header=Header.decode(r),
             data=Data.decode(r),
             evidence=EvidenceData.decode(r),
             last_commit=Commit.decode(r),
         )
+        block._marshal_cache = r.span(start)
+        block._immutable = True
+        return block
 
     @classmethod
     def unmarshal(cls, data: bytes) -> "Block":

@@ -77,12 +77,20 @@ class PartSet:
     def from_data(cls, data: bytes, part_size: int = BLOCK_PART_SIZE_BYTES) -> "PartSet":
         total = max(1, (len(data) + part_size - 1) // part_size)
         chunks = [data[i * part_size : (i + 1) * part_size] for i in range(total)]
-        root, proofs = merkle.proofs_from_byte_slices(chunks)
+        if merkle._native is not None:
+            # hash the 64kB chunks straight off the block buffer in one
+            # native call (fast-sync rebuilds a part set per block —
+            # reference's MakePartSet rehash, blockchain/reactor.go:299)
+            lhs = merkle._native.part_leaf_hashes(data, part_size)
+            root, proofs = merkle.proofs_from_leaf_hashes(lhs)
+        else:
+            root, proofs = merkle.proofs_from_byte_slices(chunks)
         ps = cls(PartSetHeader(total=total, hash=root))
         for i, chunk in enumerate(chunks):
             part = Part(index=i, bytes_=chunk, proof=proofs[i])
             ps._parts[i] = part
             ps._parts_bit_array.set_index(i, True)
+            ps._parts[i]._hash = proofs[i].leaf_hash
         ps._count = total
         return ps
 

@@ -12,6 +12,7 @@ precommits for other blocks count for availability but not power.
 from __future__ import annotations
 
 import heapq
+import struct as _struct
 from dataclasses import dataclass, field, replace
 from typing import Callable, Iterable, List, Optional, Sequence, Tuple
 
@@ -19,7 +20,11 @@ from tendermint_tpu.crypto import merkle
 from tendermint_tpu.crypto.batch import verify_generic
 from tendermint_tpu.crypto.keys import PubKey
 from tendermint_tpu.encoding.codec import Reader, Writer
-from tendermint_tpu.types.core import BlockID, SignedMsgType
+from tendermint_tpu.types.core import (
+    BlockID,
+    SignedMsgType,
+    canonical_vote_sign_bytes,
+)
 from tendermint_tpu.types.vote import Vote
 
 _MAX_TOTAL_POWER = 1 << 60  # clip bound (reference uses int64 overflow clips)
@@ -35,9 +40,11 @@ class Validator:
     voting_power: int
     accum: int = 0
 
-    @property
-    def address(self) -> bytes:
-        return self.pub_key.address()
+    def __post_init__(self):
+        # plain attribute, not a property: address is read on every
+        # compare_accum/median-time/begin-block loop iteration and the
+        # property+method+cache-lookup chain dominated those loops
+        self.address = self.pub_key.address()
 
     def copy(self) -> "Validator":
         return Validator(self.pub_key, self.voting_power, self.accum)
@@ -244,6 +251,14 @@ class ValidatorSet:
             raise CommitError("wrong block id")
 
         round = commit.round()
+        # Canonical precommit sign-bytes differ across validators ONLY in the
+        # fixed64 timestamp at offset 17 (uvarint(type)=1 + fixed64(height)=8
+        # + fixed64(round)=8) — and in block_id for stray votes. Build one
+        # template per distinct block_id and patch timestamps instead of
+        # re-encoding ~110 bytes per precommit (the sign-bytes assembly was
+        # a top host cost of fast sync; ref loop types/validator_set.go:281).
+        templates: dict = {}
+        _pack_ts = _struct.Struct("<q").pack
         pubkeys, msgs, sigs, powers = [], [], [], []
         for idx, precommit in enumerate(commit.precommits):
             if precommit is None:
@@ -256,7 +271,16 @@ class ValidatorSet:
                 raise CommitError(f"not a precommit @ index {idx}")
             val = self.validators[idx]
             pubkeys.append(val.pub_key)
-            msgs.append(precommit.sign_bytes(chain_id))
+            key = precommit.block_id
+            tpl = templates.get(key)
+            if tpl is None:
+                tpl = canonical_vote_sign_bytes(
+                    chain_id, SignedMsgType.PRECOMMIT, height, round, 0, key
+                )
+                templates[key] = tpl
+            msgs.append(
+                tpl[:17] + _pack_ts(precommit.timestamp_ns) + tpl[25:]
+            )
             sigs.append(precommit.signature)
             powers.append(
                 val.voting_power if block_id == precommit.block_id else 0

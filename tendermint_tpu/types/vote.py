@@ -116,13 +116,21 @@ class Vote:
         w.bytes(self.signature)
 
     def marshal(self) -> bytes:
-        w = Writer()
-        self.encode(w)
-        return w.build()
+        # memoized on an undeclared attribute so dataclasses.replace() can
+        # never carry a stale cache onto a modified copy; all fields are
+        # immutable, so once set the bytes are always valid
+        wire = getattr(self, "_wire", None)
+        if wire is None:
+            w = Writer()
+            self.encode(w)
+            wire = w.build()
+            object.__setattr__(self, "_wire", wire)
+        return wire
 
     @classmethod
     def decode(cls, r: Reader) -> "Vote":
-        return cls(
+        start = r.tell()
+        vote = cls(
             vote_type=SignedMsgType(r.uvarint()),
             height=r.svarint(),
             round=r.svarint(),
@@ -132,6 +140,10 @@ class Vote:
             validator_index=r.uvarint(),
             signature=r.bytes(),
         )
+        # capture the exact wire span: Commit.hash re-marshals every
+        # precommit per block on the fast-sync apply path
+        object.__setattr__(vote, "_wire", r.span(start))
+        return vote
 
     @classmethod
     def unmarshal(cls, data: bytes) -> "Vote":

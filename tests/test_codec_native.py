@@ -77,3 +77,45 @@ class TestNativeParity:
         out = w.uvarint(1).svarint(-2).bool(True).string("x").build()
         wp = codec._PyWriter()
         assert out == wp.uvarint(1).svarint(-2).bool(True).string("x").build()
+
+    def test_uvarint_full_uint64_domain(self, native_mod):
+        """Both writers accept exactly [0, 2^64) — divergent acceptance
+        would let one backend emit frames the other rejects."""
+        for v in (1 << 63, (1 << 64) - 1):
+            bp = codec._PyWriter().uvarint(v).build()
+            bn = native_mod.Writer().uvarint(v).build()
+            assert bp == bn
+            assert codec._PyReader(bp).uvarint() == v
+            assert native_mod.Reader(bn).uvarint() == v
+        for bad in (-1, 1 << 64, (1 << 64) + 5):
+            with pytest.raises(ValueError):
+                codec._PyWriter().uvarint(bad)
+            with pytest.raises(ValueError):
+                native_mod.Writer().uvarint(bad)
+
+    def test_non_minimal_uvarint_rejected(self, native_mod):
+        """Padded varints (0xC0 0x00 == 64) must be rejected by BOTH
+        readers: decode-time wire-span caching hashes the exact bytes, so
+        two encodings of one value would hash one structure two ways."""
+        cases = [b"\xc0\x00", b"\x80\x80\x00", b"\x81\x00"]
+        for data in cases:
+            with pytest.raises(ValueError):
+                codec._PyReader(data).uvarint()
+            with pytest.raises(ValueError):
+                native_mod.Reader(data).uvarint()
+        # minimal single-byte zero is of course fine
+        assert codec._PyReader(b"\x00").uvarint() == 0
+        assert native_mod.Reader(b"\x00").uvarint() == 0
+
+    def test_tell_and_span(self, native_mod):
+        for mk in (codec._PyReader, native_mod.Reader):
+            w = codec._PyWriter().uvarint(300).string("hello").fixed64(-1)
+            data = w.build()
+            r = mk(data)
+            assert r.tell() == 0
+            r.uvarint()
+            start = r.tell()
+            r.string()
+            assert r.span(start) == codec._PyWriter().string("hello").build()
+            with pytest.raises(ValueError):
+                r.span(len(data) + 10)

@@ -133,6 +133,87 @@ class TestPEXReactor:
             for sw in sws:
                 sw.stop()
 
+    def test_seed_mode_shares_then_disconnects(self):
+        """A seed answers a pex request with its (new-biased) selection and
+        hangs up after the share delay (pex_reactor.go:183-194)."""
+        # books stocked BEFORE the switches start: the first request must
+        # already see the seed's inventory
+        books = {0: AddrBook(None, strict=False), 1: AddrBook(None, strict=False)}
+        stock = [_addr(i) for i in range(40, 44)]
+        for a in stock:
+            books[0].add_address(a, a)
+        books[1].mark_attempt = lambda a: None  # keep unreachable extras
+
+        def init(i, sw):
+            if i == 0:  # the seed
+                sw.add_reactor(
+                    "pex",
+                    PEXReactor(
+                        books[i], seed_mode=True, ensure_period=0.2,
+                        seed_share_disconnect_delay=0.3,
+                        crawl_period=30,
+                    ),
+                )
+            else:
+                sw.add_reactor("pex", PEXReactor(books[i], ensure_period=0.2))
+            return sw
+
+        sws = make_connected_switches(2, init)
+        try:
+            # client requests addrs via its ensure loop; the seed must
+            # answer then drop the conn
+            assert _wait_until(
+                lambda: any(books[1].has_address(a) for a in stock), timeout=20
+            )
+            assert _wait_until(lambda: sws[0].peers.size() == 0, timeout=10)
+        finally:
+            for sw in sws:
+                sw.stop()
+
+    def test_seed_bootstraps_three_node_net(self):
+        """Two clients that only know the seed discover each other through
+        it (the seed-crawler bootstrap loop, pex_reactor.go:552)."""
+        books = {}
+
+        def init(i, sw):
+            books[i] = AddrBook(None, strict=False)
+            if i == 0:
+                sw.add_reactor(
+                    "pex",
+                    PEXReactor(
+                        books[i], seed_mode=True, ensure_period=0.3,
+                        seed_share_disconnect_delay=0.5,
+                        crawl_period=0.5, crawl_interval=0.5,
+                        seed_disconnect_wait=2.0,
+                    ),
+                )
+            else:
+                sw.add_reactor("pex", PEXReactor(books[i], ensure_period=0.3))
+            return sw
+
+        seed = make_switch(0, init_switch=init, network="seednet")
+        sw_a = make_switch(1, init_switch=init, network="seednet")
+        sw_b = make_switch(2, init_switch=init, network="seednet")
+        seed.start(), sw_a.start(), sw_b.start()
+        try:
+            seed_laddr = seed.transport.listen("127.0.0.1:0")
+            a_laddr = sw_a.transport.listen("127.0.0.1:0")
+            b_laddr = sw_b.transport.listen("127.0.0.1:0")
+            # clients know only the seed; the seed's crawler knows the clients
+            books[1].add_address(seed_laddr, seed_laddr)
+            books[2].add_address(seed_laddr, seed_laddr)
+            books[0].add_address(a_laddr, a_laddr)
+            books[0].add_address(b_laddr, b_laddr)
+            # the seed crawls a+b (harvesting their books) and serves each
+            # client the other's address; a and b then dial each other
+            assert _wait_until(
+                lambda: sw_a.peers.has(sw_b.node_id)
+                or sw_b.peers.has(sw_a.node_id),
+                timeout=30,
+            )
+        finally:
+            seed.stop(), sw_a.stop(), sw_b.stop()
+
     def test_ensure_peers_dials_from_book(self):
         """A third switch's address in the book gets dialed automatically."""
         books = {}

@@ -53,6 +53,56 @@ class TestHTTPClient:
         with pytest.raises(RPCClientError):
             client.block(10_000_000)
 
+    def test_blockchain_info(self, client):
+        """Route parity with BlockchainInfo (rpc/core/blocks.go:66):
+        newest-first metas, 20-item cap, min/max clamping."""
+        st = client.status()
+        assert wait_for(
+            lambda: client.status()["sync_info"]["latest_block_height"] >= 2,
+            timeout=30,
+        )
+        info = client.blockchain()
+        assert info["last_height"] >= 2
+        metas = info["block_metas"]
+        assert 1 <= len(metas) <= 20
+        heights = [m["header"]["height"] for m in metas]
+        assert heights == sorted(heights, reverse=True)
+        # explicit range
+        one = client.blockchain(min_height=1, max_height=1)
+        assert [m["header"]["height"] for m in one["block_metas"]] == [1]
+        # min > max errors
+        with pytest.raises(RPCClientError):
+            client.blockchain(min_height=5, max_height=2)
+
+    def test_block_results(self, client):
+        res = client.broadcast_tx_commit(b"results=route")
+        h = res["height"]
+        br = client.block_results(h)
+        assert br["height"] == h
+        dtxs = br["results"]["DeliverTx"]
+        assert len(dtxs) == 1 and dtxs[0]["code"] == 0
+        # out-of-range height errors
+        with pytest.raises(RPCClientError):
+            client.block_results(10_000_000)
+
+    def test_consensus_state_and_params(self, client):
+        cs = client.consensus_state()
+        hrs = cs["round_state"]["height/round/step"]
+        assert len(hrs.split("/")) == 3
+        cp = client.consensus_params()
+        assert cp["consensus_params"]["block_size"]["max_bytes"] > 0
+        assert cp["consensus_params"]["evidence"]["max_age"] > 0
+
+    def test_unsafe_flush_mempool(self, client):
+        client.unsafe_flush_mempool()
+
+    def test_dial_routes_require_switch(self, client):
+        # live_node runs without p2p; the route must gate cleanly, not crash
+        with pytest.raises(RPCClientError):
+            client.dial_seeds(["deadbeef@127.0.0.1:1"])
+        with pytest.raises(RPCClientError):
+            client.dial_peers(["deadbeef@127.0.0.1:1"], persistent=True)
+
     def test_ws_event_client(self, live_node):
         ws = WSEventClient(f"tcp://127.0.0.1:{live_node.rpc_server.bound_port}")
         try:
