@@ -105,16 +105,61 @@ def main():
         times.append(time.perf_counter() - t0)
     ours_s = float(np.median(times))
 
-    print(
-        json.dumps(
-            {
-                "metric": "ed25519_commit_verify_10k_validators",
-                "value": round(ours_s * 1e3, 3),
-                "unit": "ms",
-                "vs_baseline": round(baseline_s / ours_s, 2),
-            }
-        )
-    )
+    # --- on-device p50: every input device-resident, so this times the fused
+    # pipeline itself (dispatch + kernels), not the tunnel transfer that
+    # dominates the wall number above ---
+    device_p50_ms = _device_p50(verifier, pubs, msgs, sigs)
+
+    result = {
+        "metric": "ed25519_commit_verify_10k_validators",
+        "value": round(ours_s * 1e3, 3),
+        "unit": "ms",
+        "vs_baseline": round(baseline_s / ours_s, 2),
+    }
+    if device_p50_ms is not None:
+        result["device_p50_ms"] = round(device_p50_ms, 3)
+    print(json.dumps(result))
+
+
+def _device_p50(verifier, pubs, msgs, sigs, iters: int = 10):
+    """Median seconds of the packed verify dispatch with ALL inputs already
+    on device (valset limbs, signatures, message words). None when the
+    Pallas/TPU path isn't active (e.g. CPU fallback)."""
+    if getattr(verifier, "backend", None) != "pallas":
+        return None
+    try:
+        import jax
+
+        from tendermint_tpu.ops import ed25519_pallas as ep
+
+        pubs_a = np.frombuffer(b"".join(pubs), np.uint8).reshape(-1, 32)
+        sigs_a = np.frombuffer(b"".join(sigs), np.uint8).reshape(-1, 64)
+        n = pubs_a.shape[0]
+        ln = len(msgs[0])
+        b = ep._bucket(n)
+        neg_ax, ay, _valid = ep._decompress_valset(pubs_a)
+        sig_words = np.ascontiguousarray(sigs_a).view("<u4").astype(np.uint32)
+        tmpl, vrows, vwords = ep.pack_variable_words(pubs_a, msgs, sigs_a, ln, b)
+        dev = verifier._tpu
+        put = (lambda a: jax.device_put(a, dev)) if dev is not None else jax.numpy.asarray
+        negax_d, ay_d, pubw_d = ep._upload_valset(pubs_a, neg_ax, ay, b, dev)
+        sig_d = put(ep._pad_rows(sig_words, b))
+        tmpl_d, vrows_d, vwords_d = put(tmpl), put(vrows), put(vwords)
+        # warm (jit cache shared with the production dispatch above)
+        ep._device_verify_packed(
+            negax_d, ay_d, pubw_d, sig_d, tmpl_d, vrows_d, vwords_d
+        ).block_until_ready()
+        samples = []
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            ep._device_verify_packed(
+                negax_d, ay_d, pubw_d, sig_d, tmpl_d, vrows_d, vwords_d
+            ).block_until_ready()
+            samples.append(time.perf_counter() - t0)
+        return float(np.median(samples)) * 1e3
+    except Exception as e:
+        print(f"# device_p50 unavailable: {e}", file=sys.stderr)
+        return None
 
 
 if __name__ == "__main__":

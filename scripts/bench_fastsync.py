@@ -128,8 +128,13 @@ def main():
             pos += n_ok
         return applied / (time.perf_counter() - t0)
 
-    # warm the device path (compile + upload) on the first window
-    verify_block_window(st, blocks[: min(WINDOW, len(blocks))], verifier=verifier)
+    # warm the device path (compile + upload) on the first window, from a
+    # FRESH genesis state — the baseline loop's `st` has advanced past
+    # genesis and would silently warm nothing under valset churn
+    warm_st, _ = _fresh_executor(fx.genesis)
+    verify_block_window(
+        warm_st, blocks[: min(WINDOW, len(blocks))], verifier=verifier
+    )
 
     base_rate = N_BLOCKS / baseline_s
     if SWEEP:

@@ -136,11 +136,19 @@ def cmd_lite(args) -> int:
     (commands/lite.go + lite/proxy)."""
     from tendermint_tpu.lite.proxy import run_lite_proxy
 
+    if (args.trusted_height is None) != (not args.trusted_hash):
+        print(
+            "error: --trusted-height and --trusted-hash must be given together",
+            file=sys.stderr,
+        )
+        return 1
     return run_lite_proxy(
         chain_id=args.chain_id,
         node_addr=args.node,
         laddr=args.laddr,
         home=_home(args),
+        trusted_height=args.trusted_height,
+        trusted_hash=bytes.fromhex(args.trusted_hash) if args.trusted_hash else None,
     )
 
 
@@ -310,6 +318,14 @@ def main(argv=None) -> int:
     sp.add_argument("--chain-id", required=True)
     sp.add_argument("--node", default="tcp://127.0.0.1:26657")
     sp.add_argument("--laddr", default="tcp://127.0.0.1:8888")
+    sp.add_argument(
+        "--trusted-height", type=int, default=None,
+        help="root-of-trust height verified out of band (skips TOFU seeding)",
+    )
+    sp.add_argument(
+        "--trusted-hash", default="",
+        help="hex header hash at --trusted-height; mismatch aborts",
+    )
     sp.set_defaults(fn=cmd_lite)
 
     sp = sub.add_parser("testnet", help="generate a testnet config tree")

@@ -22,6 +22,13 @@ class BaseConfig:
     log_level: str = "info"
     genesis_file: str = "config/genesis.json"
     priv_validator_file: str = "config/priv_validator.json"
+    # remote signer listen address (tcp://host:port or unix://path) — when
+    # set, the node listens here for the external signer's dial-in and uses
+    # it as its PrivValidator (node.go:225-242 TCPVal/IPCVal)
+    priv_validator_laddr: str = ""
+    # optional pin: hex ed25519 pubkey the signer must authenticate its
+    # SecretConnection with; empty = accept any dialer (reference behavior)
+    priv_validator_signer_pubkey: str = ""
     node_key_file: str = "config/node_key.json"
     abci: str = "socket"
     proxy_app: str = "tcp://127.0.0.1:26658"

@@ -184,8 +184,12 @@ def verify_generic(
     verifier=None,
 ) -> np.ndarray:
     """Batch-verify over PubKey objects: ed25519 and secp256k1 keys batch to
-    their backends; anything else (multisig) verifies via verify_bytes."""
+    their backends; k-of-n threshold multisig aggregates FLATTEN into the
+    ed25519 batch (every flagged signer's sub-signature rides the same
+    device dispatch — ref threshold_pubkey.go:41-55 loops serially); only
+    structurally odd items fall back to host verify_bytes."""
     from tendermint_tpu.crypto.keys import PubKeySecp256k1
+    from tendermint_tpu.crypto.multisig import PubKeyMultisigThreshold
 
     if verifier is None:
         verifier = get_batch_verifier()
@@ -195,19 +199,34 @@ def verify_generic(
     ed_items: List[SigItem] = []
     sk_idx: List[int] = []
     sk_items: List[SigItem] = []
+    # multisig groups: (result index, start offset in ed_items, count)
+    ms_groups: List[tuple] = []
     for i, pk in enumerate(pubkeys):
         if isinstance(pk, PubKeyEd25519) and len(sigs[i]) == 64:
-            ed_idx.append(i)
+            # (result index, position in ed_items) — multisig sub-items
+            # interleave in ed_items, so positions must be explicit
+            ed_idx.append((i, len(ed_items)))
             ed_items.append(SigItem(pk.bytes(), msgs[i], sigs[i]))
         elif isinstance(pk, PubKeySecp256k1):
             sk_idx.append(i)
             sk_items.append(SigItem(pk.bytes(), msgs[i], sigs[i]))
+        elif isinstance(pk, PubKeyMultisigThreshold):
+            flat = pk.flatten(msgs[i], sigs[i])
+            if flat is None or len(flat) < pk.k:
+                # structurally invalid / non-ed25519 sub-keys / too few
+                # flagged signers — host path decides (usually False)
+                out[i] = pk.verify_bytes(msgs[i], sigs[i])
+                continue
+            ms_groups.append((i, len(ed_items), len(flat)))
+            ed_items.extend(SigItem(p, m, s) for p, m, s in flat)
         else:
             out[i] = pk.verify_bytes(msgs[i], sigs[i])
     if ed_items:
         res = verifier.verify_ed25519(ed_items)
-        for j, i in enumerate(ed_idx):
-            out[i] = res[j]
+        for i, pos in ed_idx:
+            out[i] = res[pos]
+        for i, start, cnt in ms_groups:
+            out[i] = bool(np.all(res[start : start + cnt]))
     if sk_items:
         res = verifier.verify_secp256k1(sk_items)
         for j, i in enumerate(sk_idx):

@@ -147,6 +147,10 @@ class PubKeyMultisigThreshold(PubKey):
             return False
         if len(multisig.sigs) < self.k:
             return False
+        # adversarial bytes can flag more signers than signatures supplied —
+        # reject instead of indexing out of range (the reference would panic)
+        if multisig.bitarray.count() != len(multisig.sigs):
+            return False
         # each flagged signer must verify (threshold_pubkey.go:41-55)
         sig_index = 0
         for i in range(size):
@@ -169,6 +173,8 @@ class PubKeyMultisigThreshold(PubKey):
             return None
         if len(multisig.sigs) < self.k:
             return None
+        if multisig.bitarray.count() != len(multisig.sigs):
+            return None  # mirrors verify_bytes' mismatch rejection
         out = []
         sig_index = 0
         for i in range(len(self.pubkeys)):
@@ -178,7 +184,13 @@ class PubKeyMultisigThreshold(PubKey):
                     return None
                 if sig_index >= len(multisig.sigs):
                     return None
-                out.append((pk.bytes(), msg, multisig.sigs[sig_index]))
+                sub = multisig.sigs[sig_index]
+                if len(sub) != 64:
+                    # unmarshal accepts any sub-sig length; a short one would
+                    # crash the whole batched dispatch downstream (frombuffer
+                    # reshape) — bail to the host path, which returns False
+                    return None
+                out.append((pk.bytes(), msg, sub))
                 sig_index += 1
         return out
 

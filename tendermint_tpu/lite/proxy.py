@@ -64,12 +64,33 @@ class RPCProvider(Provider):
 class LiteProxy:
     """Certifies heights on demand and serves them (lite/proxy/proxy.go)."""
 
-    def __init__(self, chain_id: str, node_addr: str, trust_db=None):
+    def __init__(
+        self,
+        chain_id: str,
+        node_addr: str,
+        trust_db=None,
+        trusted_height: Optional[int] = None,
+        trusted_hash: Optional[bytes] = None,
+    ):
+        """trusted_height/trusted_hash: an explicit root of trust — the
+        header hash the operator verified out of band. Without it, first
+        run falls back to trust-on-first-use: the UNTRUSTED backing node's
+        height-1 FullCommit defines the chain permanently (the trust DB
+        persists it), which a malicious first contact can exploit."""
         self.chain_id = chain_id
         self.source = RPCProvider(node_addr)
         self.trusted = DBProvider(trust_db if trust_db is not None else _memdb())
         self.verifier = DynamicVerifier(chain_id, self.trusted, self.source)
         self._client = HTTPClient(node_addr)
+        if (trusted_height is None) != (trusted_hash is None):
+            # height without hash would silently trust the untrusted node's
+            # header at that height — the exact TOFU hole the pin exists to
+            # close; hash without height is a dropped pin
+            raise ValueError(
+                "trusted_height and trusted_hash must be given together"
+            )
+        self.trusted_height = trusted_height
+        self.trusted_hash = trusted_hash
         self._seeded = False
 
     def _ensure_seed(self) -> None:
@@ -78,9 +99,31 @@ class LiteProxy:
         try:
             self.trusted.latest_full_commit(self.chain_id, 1, 1 << 60)
         except ProviderError:
-            # TOFU seed at the node's earliest available height (commands/
-            # lite.go trusts the first fetch; operators can pre-seed the DB)
-            fc = self.source.full_commit_at(self.chain_id, 1)
+            if self.trusted_height is not None:
+                # operator-supplied root of trust: fetch that height and
+                # check the header hash matches before anchoring on it
+                fc = self.source.full_commit_at(self.chain_id, self.trusted_height)
+                got = fc.signed_header.header.hash()
+                if got != self.trusted_hash:
+                    raise ProviderError(
+                        f"trusted header mismatch at height {self.trusted_height}: "
+                        f"node serves {got.hex()}, operator pinned "
+                        f"{self.trusted_hash.hex()}"
+                    )
+            else:
+                # TOFU seed at the node's earliest available height (commands/
+                # lite.go trusts the first fetch; operators can pre-seed the
+                # DB or pass trusted_height/hash instead)
+                import logging
+
+                logging.getLogger("lite.proxy").warning(
+                    "TRUST-ON-FIRST-USE: seeding the light-client trust store "
+                    "from the UNTRUSTED node at height 1 — a malicious first "
+                    "contact defines the chain permanently; pass "
+                    "trusted_height/trusted_hash (or --trusted-height/"
+                    "--trusted-hash) to pin a verified root of trust"
+                )
+                fc = self.source.full_commit_at(self.chain_id, 1)
             self.verifier.init_from_full_commit(fc)
         self._seeded = True
 
@@ -134,12 +177,22 @@ def _memdb():
     return MemDB()
 
 
-def run_lite_proxy(chain_id: str, node_addr: str, laddr: str, home: str) -> int:
+def run_lite_proxy(
+    chain_id: str,
+    node_addr: str,
+    laddr: str,
+    home: str,
+    trusted_height: Optional[int] = None,
+    trusted_hash: Optional[bytes] = None,
+) -> int:
     """Serve /status and /commit?height=N with verified-only data."""
     import os
 
     trust_db = new_db("lite_trust", "sqlite", os.path.join(home, "data"))
-    proxy = LiteProxy(chain_id, node_addr, trust_db)
+    proxy = LiteProxy(
+        chain_id, node_addr, trust_db,
+        trusted_height=trusted_height, trusted_hash=trusted_hash,
+    )
 
     class Handler(BaseHTTPRequestHandler):
         def log_message(self, fmt, *args):

@@ -85,7 +85,40 @@ class Node(BaseService):
         state = handshaker.handshake(self.proxy_app)
         sm_store.save_state(self.state_db, state)
 
-        # priv validator
+        # priv validator — remote signer endpoint when configured
+        # (node.go:225-242: TCPVal/IPCVal listen for the signer's dial-in)
+        self.signer_endpoint = None
+        if config.base.priv_validator_laddr:
+            from tendermint_tpu.crypto.keys import PubKeyEd25519
+            from tendermint_tpu.privval.remote_signer import (
+                SignerValidatorEndpoint,
+            )
+
+            expected = None
+            if config.base.priv_validator_signer_pubkey:
+                if config.base.priv_validator_laddr.startswith("unix"):
+                    # the pin authenticates the SecretConnection handshake,
+                    # which unix sockets don't do — with a pin set, every
+                    # signer would be silently rejected forever
+                    raise ValueError(
+                        "priv_validator_signer_pubkey requires a tcp:// "
+                        "priv_validator_laddr (unix sockets have no "
+                        "authenticated handshake to pin)"
+                    )
+                expected = PubKeyEd25519(
+                    bytes.fromhex(config.base.priv_validator_signer_pubkey)
+                )
+            self.signer_endpoint = SignerValidatorEndpoint(
+                config.base.priv_validator_laddr,
+                expected_signer_pubkey=expected,
+            )
+            self.signer_endpoint.start()
+            if not self.signer_endpoint.wait_for_signer():
+                raise RuntimeError(
+                    "no remote signer dialed "
+                    f"{config.base.priv_validator_laddr} before the deadline"
+                )
+            priv_validator = self.signer_endpoint
         self.priv_validator = priv_validator
 
         # event bus + indexer
@@ -360,7 +393,7 @@ class Node(BaseService):
         # switch first: it stops its reactors, which stop the consensus state
         services = [self.switch] if self.switch is not None else [self.consensus_state]
         services += [self.rpc_server, self.grpc_broadcast, self.indexer_service,
-                     self.event_bus, self.proxy_app]
+                     self.event_bus, self.proxy_app, self.signer_endpoint]
         for svc in services:
             if svc is None:
                 continue

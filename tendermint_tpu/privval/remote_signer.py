@@ -223,10 +223,21 @@ class SignerValidatorEndpoint(BaseService, PrivValidator):
     PrivValidator — every sign call becomes a request over the wire
     (tcp.go TCPVal / ipc.go IPCVal)."""
 
-    def __init__(self, addr: str, conn_key: Optional[PrivKey] = None):
+    def __init__(
+        self,
+        addr: str,
+        conn_key: Optional[PrivKey] = None,
+        expected_signer_pubkey: Optional[PubKey] = None,
+    ):
+        """expected_signer_pubkey: pin the signer's SecretConnection identity
+        (tcp only). Without it, ANY dialer that completes the handshake
+        replaces the active signer — matching the reference's TCPVal, but a
+        known weakness there: anyone who can reach priv_validator_laddr can
+        evict the real signer or serve a chosen pubkey."""
         BaseService.__init__(self, name="SignerValidator")
         self.addr = addr
         self.conn_key = conn_key or PrivKeyEd25519.generate()
+        self.expected_signer_pubkey = expected_signer_pubkey
         self._listener: Optional[socket.socket] = None
         self._conn: Optional[_Conn] = None
         self._connected = threading.Event()
@@ -288,8 +299,25 @@ class SignerValidatorEndpoint(BaseService, PrivValidator):
                 except OSError:
                     pass
                 continue
+            if self.expected_signer_pubkey is not None:
+                remote = getattr(conn._io, "remote_pubkey", None)
+                if remote is None or remote.bytes() != self.expected_signer_pubkey.bytes():
+                    self.logger.error(
+                        "rejecting signer connection: authenticated key %s "
+                        "does not match the pinned signer pubkey",
+                        remote.address().hex() if remote is not None else "<none>",
+                    )
+                    conn.close()
+                    continue
             old, self._conn = self._conn, conn
             if old is not None:
+                # matches the reference's accept-any TCPVal behavior, but an
+                # eviction is worth an operator's attention: a dialer just
+                # displaced the live signer (pin expected_signer_pubkey to
+                # prevent untrusted dialers doing this)
+                self.logger.warning(
+                    "active remote signer connection displaced by a new dial-in"
+                )
                 old.close()
             self._pubkey = None  # re-fetch from the (possibly new) signer
             self._connected.set()

@@ -219,6 +219,86 @@ class TestMultisig:
 
         assert all(ed.verify(pk, m, s) for pk, m, s in flat)
 
+    def test_batched_aggregate_matches_host(self):
+        """verify_generic flattens multisig aggregates into the ed25519
+        batch; results must match per-aggregate verify_bytes exactly —
+        including interleave with plain ed25519 keys."""
+        from tendermint_tpu.crypto.batch import HostBatchVerifier, verify_generic
+
+        privs, pubs = self._keys(5)
+        mpk = PubKeyMultisigThreshold(k=3, pubkeys=tuple(pubs))
+        msg = b"batch multisig"
+
+        def agg(signers, sign_msg=msg):
+            ms = Multisignature.new(5)
+            for i in signers:
+                ms.add_signature_from_pubkey(privs[i].sign(sign_msg), pubs[i], pubs)
+            return ms.marshal()
+
+        good = agg((0, 2, 4))
+        below = agg((1, 3))
+        bad_inner = Multisignature.new(5)
+        bad_inner.add_signature_from_pubkey(privs[0].sign(msg), pubs[0], pubs)
+        bad_inner.add_signature_from_pubkey(privs[2].sign(b"oth"), pubs[2], pubs)
+        bad_inner.add_signature_from_pubkey(privs[4].sign(msg), pubs[4], pubs)
+        bad = bad_inner.marshal()
+
+        # interleave a plain ed25519 item so positions shift
+        plain_priv, plain_pub = privs[0], pubs[0]
+        plain_sig = plain_priv.sign(b"plain")
+
+        pubkeys = [mpk, plain_pub, mpk, mpk]
+        msgs = [msg, b"plain", msg, msg]
+        sigs = [good, plain_sig, below, bad]
+        got = verify_generic(pubkeys, msgs, sigs, verifier=HostBatchVerifier())
+        want = [
+            mpk.verify_bytes(msg, good),
+            plain_pub.verify_bytes(b"plain", plain_sig),
+            mpk.verify_bytes(msg, below),
+            mpk.verify_bytes(msg, bad),
+        ]
+        assert list(got) == want == [True, True, False, False]
+
+    def test_short_sub_signature_rejected_not_crashing(self):
+        """A flagged sub-signature that isn't 64 bytes must fail cleanly —
+        in the batch path it would otherwise crash the WHOLE dispatch
+        (frombuffer reshape), taking valid items down with it."""
+        from tendermint_tpu.crypto.batch import HostBatchVerifier, verify_generic
+
+        privs, pubs = self._keys(3)
+        mpk = PubKeyMultisigThreshold(k=2, pubkeys=tuple(pubs))
+        msg = b"m"
+        ms = Multisignature.new(3)
+        ms.add_signature_from_pubkey(privs[0].sign(msg), pubs[0], pubs)
+        ms.add_signature_from_pubkey(b"\x01" * 32, pubs[1], pubs)  # short sig
+        blob = ms.marshal()
+        assert mpk.flatten(msg, blob) is None
+        assert mpk.verify_bytes(msg, blob) is False
+        # and through the batch boundary, alongside a valid plain item
+        plain_sig = privs[2].sign(b"p")
+        got = verify_generic(
+            [mpk, pubs[2]], [msg, b"p"], [blob, plain_sig],
+            verifier=HostBatchVerifier(),
+        )
+        assert list(got) == [False, True]
+
+    def test_flagged_count_sig_count_mismatch_rejected(self):
+        """More flagged signers than signatures (adversarial bytes) must be
+        rejected, not crash (the reference would index out of range)."""
+        privs, pubs = self._keys(3)
+        mpk = PubKeyMultisigThreshold(k=2, pubkeys=tuple(pubs))
+        msg = b"m"
+        ms = Multisignature.new(3)
+        ms.add_signature_from_pubkey(privs[0].sign(msg), pubs[0], pubs)
+        ms.add_signature_from_pubkey(privs[1].sign(msg), pubs[1], pubs)
+        blob = bytearray(ms.marshal())
+        # flag a third bit without appending a signature
+        ba = CompactBitArray(3)
+        ba.set_index(0, True), ba.set_index(1, True), ba.set_index(2, True)
+        tampered = ba.to_bytes() + bytes(blob[4 + 1 :])  # 3 bits fit 1 byte
+        assert mpk.verify_bytes(msg, bytes(tampered)) is False
+        assert mpk.flatten(msg, bytes(tampered)) is None
+
     def test_compact_bitarray(self):
         ba = CompactBitArray(10)
         ba.set_index(3, True)

@@ -128,6 +128,21 @@ class TestLiteProxy:
             )
             with pytest.raises((LiteError, ProviderError)):
                 bad.status()
+
+            # operator root of trust: correct pinned hash verifies ...
+            addr = f"tcp://127.0.0.1:{node.rpc_server.bound_port}"
+            h2 = node.block_store.load_block_meta(2).block_id.hash
+            pinned = LiteProxy(
+                "lite-proxy-chain", addr, trusted_height=2, trusted_hash=h2
+            )
+            assert pinned.status()["verified"]
+            # ... a wrong pinned hash aborts seeding instead of trusting
+            wrong = LiteProxy(
+                "lite-proxy-chain", addr,
+                trusted_height=2, trusted_hash=b"\x13" * 32,
+            )
+            with pytest.raises(ProviderError):
+                wrong.status()
         finally:
             node.stop()
 

@@ -117,6 +117,34 @@ class TestRemoteSignerUnix:
 
 
 class TestConsensusWithRemoteSigner:
+    def test_pinned_signer_pubkey_rejects_impostor(self, tmp_path):
+        """With expected_signer_pubkey set, a dialer whose SecretConnection
+        identity differs is rejected and cannot evict/become the signer."""
+        from tendermint_tpu.crypto.keys import PrivKeyEd25519
+
+        pv = FilePV.generate(str(tmp_path / "pv.json"))
+        good_key = PrivKeyEd25519.generate(b"\x11" * 32)
+        bad_key = PrivKeyEd25519.generate(b"\x22" * 32)
+        node_end = SignerValidatorEndpoint(
+            "tcp://127.0.0.1:0",
+            expected_signer_pubkey=good_key.pub_key(),
+        )
+        node_end.start()
+        addr = f"tcp://127.0.0.1:{node_end.listen_port}"
+        try:
+            impostor = SignerServiceEndpoint(addr, pv, conn_key=bad_key)
+            impostor.start()
+            assert not node_end.wait_for_signer(2)
+            impostor.stop()
+            # the real signer (pinned key) connects fine
+            signer = SignerServiceEndpoint(addr, pv, conn_key=good_key)
+            signer.start()
+            assert node_end.wait_for_signer(10)
+            assert node_end.get_pub_key().bytes() == pv.get_pub_key().bytes()
+            signer.stop()
+        finally:
+            node_end.stop()
+
     def test_single_validator_commits_via_remote_signer(self, tmp_path):
         """The reference wires TCPVal as the node's PrivValidator
         (node/node.go:225-242): a consensus state whose every sign goes over

@@ -1,0 +1,115 @@
+"""WAL decoder fuzzing — adversarial bytes against the framed CRC decoder
+(ref: consensus/wal_fuzz.go, the go-fuzz entry for NewWALDecoder; the p2p
+conn has its own fuzz wrapper, this covers the OTHER untrusted-bytes
+surface).
+
+Invariants under arbitrary input:
+  * decode either yields messages or raises DataCorruptionError — never
+    any other exception, never a hang;
+  * every successfully decoded message re-encodes (wal_fuzz.go's check);
+  * valid prefixes survive: records before the corruption point decode.
+"""
+
+import os
+import random
+import struct
+import zlib
+
+import pytest
+
+from tendermint_tpu.consensus.messages import EndHeightMessage, encode_msg
+from tendermint_tpu.consensus.wal import (
+    WAL,
+    DataCorruptionError,
+    TimedWALMessage,
+)
+from tendermint_tpu.encoding.codec import Writer, encode_uvarint
+
+
+def _record(payload: bytes) -> bytes:
+    return struct.pack("<I", zlib.crc32(payload)) + encode_uvarint(len(payload)) + payload
+
+
+def _valid_wal_bytes(n_msgs: int = 8) -> bytes:
+    out = b""
+    for i in range(n_msgs):
+        tm = TimedWALMessage(1_700_000_000_000_000_000 + i, EndHeightMessage(i))
+        out += _record(tm.marshal())
+    return out
+
+
+def _decode_all(tmp_path, data: bytes, name: str):
+    """Feed raw bytes through the real WAL read path."""
+    path = str(tmp_path / name)
+    with open(path, "wb") as f:
+        f.write(data)
+    wal = WAL(path)
+    msgs = []
+    try:
+        for tm in wal.iter_all():
+            # wal_fuzz.go invariant: a decoded message must re-encode
+            w = Writer()
+            encode_msg(tm.msg, w)
+            assert w.build()
+            msgs.append(tm)
+    finally:
+        wal.group.close()
+    return msgs
+
+
+class TestWALFuzz:
+    def test_valid_stream_roundtrips(self, tmp_path):
+        msgs = _decode_all(tmp_path, _valid_wal_bytes(8), "valid")
+        assert len(msgs) == 8
+        assert [m.msg.height for m in msgs] == list(range(8))
+
+    def test_random_bytes_never_crash(self, tmp_path):
+        rng = random.Random(1337)
+        for trial in range(300):
+            data = rng.randbytes(rng.randrange(0, 400))
+            try:
+                _decode_all(tmp_path, data, f"rand{trial}")
+            except DataCorruptionError:
+                pass  # the ONLY acceptable failure mode
+
+    def test_truncations_of_valid_stream(self, tmp_path):
+        data = _valid_wal_bytes(6)
+        for cut in range(len(data)):
+            try:
+                msgs = _decode_all(tmp_path, data[:cut], f"trunc{cut}")
+                # a clean cut at a record boundary yields a valid prefix
+                assert all(m.msg.height == i for i, m in enumerate(msgs))
+            except DataCorruptionError:
+                pass
+
+    def test_bit_flips_detected_or_tolerated(self, tmp_path):
+        rng = random.Random(7)
+        data = _valid_wal_bytes(6)
+        for trial in range(200):
+            buf = bytearray(data)
+            pos = rng.randrange(len(buf))
+            buf[pos] ^= 1 << rng.randrange(8)
+            try:
+                msgs = _decode_all(tmp_path, bytes(buf), f"flip{trial}")
+            except DataCorruptionError:
+                continue
+            # decode "succeeded": every yielded message must still be sane
+            # (a flip inside a timestamp passes CRC-guarded... no — CRC
+            # covers the payload, so an undetected flip can only live in
+            # a record's CRC field making THAT record fail; all yielded
+            # records are bit-exact originals)
+            for i, m in enumerate(msgs):
+                assert m.msg.height == i
+
+    def test_giant_length_rejected_without_allocation(self, tmp_path):
+        payload = b"x"
+        rec = struct.pack("<I", zlib.crc32(payload)) + encode_uvarint(1 << 40) + payload
+        with pytest.raises(DataCorruptionError):
+            _decode_all(tmp_path, rec, "giant")
+
+    def test_crc_mismatch_rejected(self, tmp_path):
+        tm = TimedWALMessage(1, EndHeightMessage(3))
+        payload = tm.marshal()
+        rec = struct.pack("<I", zlib.crc32(payload) ^ 0xDEAD) + encode_uvarint(len(payload)) + payload
+        with pytest.raises(DataCorruptionError):
+            _decode_all(tmp_path, rec, "badcrc")
