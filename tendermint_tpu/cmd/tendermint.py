@@ -142,13 +142,23 @@ def cmd_lite(args) -> int:
             file=sys.stderr,
         )
         return 1
+    trusted_hash = None
+    if args.trusted_hash:
+        try:
+            trusted_hash = bytes.fromhex(args.trusted_hash.removeprefix("0x"))
+        except ValueError:
+            print("error: --trusted-hash is not valid hex", file=sys.stderr)
+            return 1
+        if len(trusted_hash) != 32:
+            print("error: --trusted-hash must be 32 bytes of hex", file=sys.stderr)
+            return 1
     return run_lite_proxy(
         chain_id=args.chain_id,
         node_addr=args.node,
         laddr=args.laddr,
         home=_home(args),
         trusted_height=args.trusted_height,
-        trusted_hash=bytes.fromhex(args.trusted_hash) if args.trusted_hash else None,
+        trusted_hash=trusted_hash,
     )
 
 

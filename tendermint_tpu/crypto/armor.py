@@ -36,8 +36,7 @@ def encode_armor(block_type: str, headers: Dict[str, str], data: bytes) -> str:
     body = base64.b64encode(data).decode()
     for i in range(0, len(body), _LINE):
         lines.append(body[i : i + _LINE])
-    if not body:
-        pass  # empty payload still gets a checksum line
+    # an empty payload still gets its checksum line
     crc = base64.b64encode(_crc24(data).to_bytes(3, "big")).decode()
     lines.append(f"={crc}")
     lines.append(f"-----END {block_type}-----")

@@ -22,8 +22,7 @@ Accept/reject is bit-exact across backends (tests/test_ops_ed25519.py).
 from __future__ import annotations
 
 import threading
-from dataclasses import dataclass
-from typing import List, Optional, Sequence
+from typing import List, NamedTuple, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -31,9 +30,10 @@ from tendermint_tpu.crypto import ed25519 as _ed
 from tendermint_tpu.crypto.keys import PubKey, PubKeyEd25519
 
 
-@dataclass(frozen=True)
-class SigItem:
-    """One signature-verification work item."""
+class SigItem(NamedTuple):
+    """One signature-verification work item. (NamedTuple, not dataclass:
+    tens of thousands are created per fast-sync window and tuple
+    construction is several times cheaper.)"""
 
     pubkey: bytes  # raw 32-byte ed25519 key (or PubKey for generic items)
     msg: bytes
@@ -120,19 +120,27 @@ class TPUBatchVerifier:
         return np.asarray(ok, dtype=bool)
 
     def verify_secp256k1(self, items: Sequence[SigItem]) -> np.ndarray:
-        """Batched ECDSA on device (ops/secp256k1_verify XLA kernel; the
-        pallas backend shares it — ECDSA has no pallas pipeline yet)."""
+        """Batched ECDSA on device. The pallas backend dispatches the fused
+        windowed-Straus kernel (ops/secp256k1_pallas) on the real chip;
+        otherwise the portable XLA kernel (mesh/shard_map-able) runs."""
         if len(items) == 0:
             return np.zeros((0,), dtype=bool)
         from tendermint_tpu.crypto.hashing import sha256
-        from tendermint_tpu.ops import secp256k1_verify as _sk
 
-        ok = _sk.verify_batch(
-            [it.pubkey for it in items],
-            [sha256(it.msg) for it in items],
-            [it.sig for it in items],
-            mesh=self._mesh,
-        )
+        pubs = [it.pubkey for it in items]
+        digs = [sha256(it.msg) for it in items]
+        sigs = [it.sig for it in items]
+        if self.backend == "pallas":
+            import jax
+
+            from tendermint_tpu.ops import secp256k1_pallas as _skp
+
+            dev = None if jax.default_backend() == "tpu" else self._tpu
+            ok = _skp.verify_batch(pubs, digs, sigs, device=dev)
+        else:
+            from tendermint_tpu.ops import secp256k1_verify as _sk
+
+            ok = _sk.verify_batch(pubs, digs, sigs, mesh=self._mesh)
         return np.asarray(ok, dtype=bool)
 
 
@@ -195,7 +203,7 @@ def verify_generic(
         verifier = get_batch_verifier()
     n = len(pubkeys)
     out = np.zeros((n,), dtype=bool)
-    ed_idx: List[int] = []
+    ed_idx: List[Tuple[int, int]] = []  # (result index, position in ed_items)
     ed_items: List[SigItem] = []
     sk_idx: List[int] = []
     sk_items: List[SigItem] = []

@@ -80,16 +80,15 @@ def hsalsa20(key: bytes, nonce16: bytes) -> bytes:
     return struct.pack("<8I", *(x[i] for i in (0, 5, 10, 15, 6, 7, 8, 9)))
 
 
-def _xsalsa20_stream(key: bytes, nonce24: bytes, length: int, first_block_skip: int = 0):
-    """Keystream generator for XSalsa20: HSalsa20 subkey + 8-byte nonce tail."""
+def _xsalsa20_stream(key: bytes, nonce24: bytes, length: int) -> bytes:
+    """Keystream for XSalsa20: HSalsa20 subkey + 8-byte nonce tail."""
     subkey = hsalsa20(key, nonce24[:16])
     out = bytearray()
     counter = 0
-    total = length + first_block_skip
-    while len(out) < total:
+    while len(out) < length:
         out += _salsa20_block(subkey, nonce24[16:], counter)
         counter += 1
-    return bytes(out[first_block_skip : first_block_skip + length])
+    return bytes(out[:length])
 
 
 def _poly1305(key32: bytes, msg: bytes) -> bytes:

@@ -96,35 +96,69 @@ class LiteProxy:
     def _ensure_seed(self) -> None:
         if self._seeded:
             return
+        store_has_chain = True
         try:
             self.trusted.latest_full_commit(self.chain_id, 1, 1 << 60)
         except ProviderError:
-            if self.trusted_height is not None:
-                # operator-supplied root of trust: fetch that height and
-                # check the header hash matches before anchoring on it
-                fc = self.source.full_commit_at(self.chain_id, self.trusted_height)
-                got = fc.signed_header.header.hash()
-                if got != self.trusted_hash:
-                    raise ProviderError(
-                        f"trusted header mismatch at height {self.trusted_height}: "
-                        f"node serves {got.hex()}, operator pinned "
-                        f"{self.trusted_hash.hex()}"
-                    )
-            else:
-                # TOFU seed at the node's earliest available height (commands/
-                # lite.go trusts the first fetch; operators can pre-seed the
-                # DB or pass trusted_height/hash instead)
-                import logging
+            store_has_chain = False
 
-                logging.getLogger("lite.proxy").warning(
-                    "TRUST-ON-FIRST-USE: seeding the light-client trust store "
-                    "from the UNTRUSTED node at height 1 — a malicious first "
-                    "contact defines the chain permanently; pass "
-                    "trusted_height/trusted_hash (or --trusted-height/"
-                    "--trusted-hash) to pin a verified root of trust"
+        if store_has_chain:
+            # the persistent store already has a chain: an explicit pin must
+            # still be honored — a store seeded by TOFU from a malicious
+            # first contact would otherwise silently win over the pin
+            if self.trusted_height is not None:
+                at_pin = None
+                try:
+                    at_pin = self.trusted.latest_full_commit(
+                        self.chain_id, self.trusted_height, self.trusted_height
+                    )
+                except ProviderError:
+                    pass
+                if at_pin is None:
+                    import logging
+
+                    logging.getLogger("lite.proxy").warning(
+                        "trust store has no entry at pinned height %d — the "
+                        "pin cannot be cross-checked against the existing "
+                        "store; reset the trust DB to re-anchor",
+                        self.trusted_height,
+                    )
+                elif at_pin.signed_header.header.hash() != self.trusted_hash:
+                    raise ProviderError(
+                        f"trust store conflicts with the pinned hash at "
+                        f"height {self.trusted_height} — reset the lite "
+                        f"trust DB (it may have been TOFU-seeded from a "
+                        f"malicious node)"
+                    )
+            self._seeded = True
+            return
+
+        if self.trusted_height is not None:
+            # operator-supplied root of trust: fetch that height and check
+            # the header hash matches before anchoring on it
+            fc = self.source.full_commit_at(self.chain_id, self.trusted_height)
+            got = fc.signed_header.header.hash()
+            if got != self.trusted_hash:
+                raise ProviderError(
+                    f"trusted header mismatch at height {self.trusted_height}: "
+                    f"node serves {got.hex()}, operator pinned "
+                    f"{self.trusted_hash.hex()}"
                 )
-                fc = self.source.full_commit_at(self.chain_id, 1)
-            self.verifier.init_from_full_commit(fc)
+        else:
+            # TOFU seed at the node's earliest available height (commands/
+            # lite.go trusts the first fetch; operators can pre-seed the
+            # DB or pass trusted_height/hash instead)
+            import logging
+
+            logging.getLogger("lite.proxy").warning(
+                "TRUST-ON-FIRST-USE: seeding the light-client trust store "
+                "from the UNTRUSTED node at height 1 — a malicious first "
+                "contact defines the chain permanently; pass "
+                "trusted_height/trusted_hash (or --trusted-height/"
+                "--trusted-hash) to pin a verified root of trust"
+            )
+            fc = self.source.full_commit_at(self.chain_id, 1)
+        self.verifier.init_from_full_commit(fc)
         self._seeded = True
 
     def certified_commit(self, height: Optional[int] = None) -> FullCommit:

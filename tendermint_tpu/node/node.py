@@ -114,6 +114,13 @@ class Node(BaseService):
             )
             self.signer_endpoint.start()
             if not self.signer_endpoint.wait_for_signer():
+                # tear the endpoint down before raising: __init__ failure
+                # means stop() can never run, and a zombie accept loop would
+                # hold the port (and greet late dialers) forever
+                try:
+                    self.signer_endpoint.stop()
+                except Exception:
+                    pass
                 raise RuntimeError(
                     "no remote signer dialed "
                     f"{config.base.priv_validator_laddr} before the deadline"

@@ -119,26 +119,38 @@ def fe_sub(a, b):
 
 
 def fe_mul(a, b):
+    """Bounds (limbs of carried inputs ≤ M = 13000, columns ≤ 20·M² < 2^32):
+
+    The product occupies rows 0..38; carries ripple one row per round, so
+    THREE rounds need rows out to 40 — a 40-limb buffer would silently drop
+    the carry out of row 39 (≈2^520-weight value loss; miscomputed ~20% of
+    near-bound products before this was widened). After 3 rounds: rows ≤
+    MASK + ~50, row 39 ≤ ~50, row 40 = 0-or-tiny, nothing dropped.
+
+    Fold rows 20..40 (v·2^(260+13j) ≡ v·2^13j·(2^36+15632)): the shift
+    lands 2 rows up, so the temp needs 24 rows (fold touches ≤ row 22);
+    temp rows ≤ 8191 + 8241·15632 + 8241·1024 < 1.4e8. Two carry rounds
+    leave rows ≤ ~8200 and reach at most row 23 (no carry out of the last
+    row: it is ≤ 6 after round 1). The 4 tail rows then fold scalar-wise
+    into lo with FULL values (≤ 8200·15632 < 2^27 — nothing masked away).
+    """
     shape = jnp.broadcast_shapes(a.shape[:-1], b.shape[:-1])
-    prod = jnp.zeros(shape + (2 * NLIMB,), dtype=jnp.uint32)
+    prod = jnp.zeros(shape + (2 * NLIMB + 1,), dtype=jnp.uint32)
     for i in range(NLIMB):
         prod = prod.at[..., i : i + NLIMB].add(a[..., i : i + 1] * b)
     for _ in range(3):
         c = prod >> BITS
         prod = (prod & MASK).at[..., 1:].add(c[..., :-1])
-    # fold limbs 20..39: v·2^(260+13j) ≡ v·2^13j·(2^36 + 15632); the shift
-    # lands 2 limbs up, so fold into a 23-limb temp, carry it small, then
-    # fold the 3 tail limbs (values ≤ MASK keep every product < 2^28)
-    hi = prod[..., NLIMB:]
-    tmp = jnp.zeros(shape + (NLIMB + 3,), dtype=jnp.uint32)
+    hi = prod[..., NLIMB:]  # 21 rows
+    tmp = jnp.zeros(shape + (NLIMB + 4,), dtype=jnp.uint32)
     tmp = tmp.at[..., :NLIMB].set(prod[..., :NLIMB])
-    tmp = tmp.at[..., :NLIMB].add(hi * FOLD_SMALL)
-    tmp = tmp.at[..., 2 : NLIMB + 2].add(hi << FOLD_SHIFT)
+    tmp = tmp.at[..., : NLIMB + 1].add(hi * FOLD_SMALL)
+    tmp = tmp.at[..., 2 : NLIMB + 3].add(hi << FOLD_SHIFT)
     for _ in range(2):
         c = tmp >> BITS
         tmp = (tmp & MASK).at[..., 1:].add(c[..., :-1])
     lo = tmp[..., :NLIMB]
-    for t_idx in range(3):
+    for t_idx in range(4):
         t = tmp[..., NLIMB + t_idx]
         lo = lo.at[..., t_idx].add(t * FOLD_SMALL)
         lo = lo.at[..., t_idx + 2].add(t << FOLD_SHIFT)
@@ -337,6 +349,30 @@ def _bucket(n: int, mesh=None) -> int:
     return b
 
 
+def prep_item(pubkey: bytes, digest: bytes, sig: bytes):
+    """Host prologue for ONE signature: strict-DER parse + range/low-s
+    checks, w = s⁻¹ mod n, scalars, cached decompression. Returns either
+    ("forced", 0|1) for host-decided items or
+    ("kernel", (qx, qy), u1, u2, r) for device verification. Shared by the
+    XLA kernel and the Pallas pipeline so accept/reject can never drift."""
+    Q = _decompress_cached(pubkey)
+    parsed = _s.der_decode_sig(sig)
+    if Q is None or parsed is None:
+        return ("forced", 0)
+    r, s = parsed
+    if not (0 < r < N and 0 < s < N) or s > _s._HALF_N:
+        return ("forced", 0)
+    e = int.from_bytes(digest, "big")
+    w = pow(s, N - 2, N)
+    u1 = e * w % N
+    u2 = r * w % N
+    if u1 == 0 or u2 == 0:
+        # ladder degenerates to single-scalar — host decides (never
+        # happens for honestly generated signatures)
+        return ("forced", int(_s.verify(pubkey, digest, sig)))
+    return ("kernel", Q, u1, u2, r)
+
+
 def verify_batch(
     pubkeys: Sequence[bytes],
     digests: Sequence[bytes],
@@ -361,26 +397,11 @@ def verify_batch(
     forced = np.full((b,), -1, np.int8)
 
     for i in range(n):
-        Q = _decompress_cached(bytes(pubkeys[i]))
-        parsed = _s.der_decode_sig(bytes(sigs[i]))
-        if Q is None or parsed is None:
-            forced[i] = 0
+        item = prep_item(bytes(pubkeys[i]), bytes(digests[i]), bytes(sigs[i]))
+        if item[0] == "forced":
+            forced[i] = item[1]
             continue
-        r, s = parsed
-        if not (0 < r < N and 0 < s < N) or s > _s._HALF_N:
-            forced[i] = 0
-            continue
-        e = int.from_bytes(bytes(digests[i]), "big")
-        w = pow(s, N - 2, N)
-        u1 = e * w % N
-        u2 = r * w % N
-        if u1 == 0 or u2 == 0:
-            # ladder degenerates to single-scalar — host decides (never
-            # happens for honestly generated signatures)
-            forced[i] = int(
-                _s.verify(bytes(pubkeys[i]), bytes(digests[i]), bytes(sigs[i]))
-            )
-            continue
+        _, Q, u1, u2, r = item
         qx[i], qy[i] = Q
         u1w[i] = _scalar_words(u1)
         u2w[i] = _scalar_words(u2)

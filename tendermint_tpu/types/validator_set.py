@@ -47,7 +47,14 @@ class Validator:
         self.address = self.pub_key.address()
 
     def copy(self) -> "Validator":
-        return Validator(self.pub_key, self.voting_power, self.accum)
+        # bypass __init__/__post_init__: three whole-set copies run per
+        # applied block (update_state), and the address is already computed
+        v = Validator.__new__(Validator)
+        v.pub_key = self.pub_key
+        v.voting_power = self.voting_power
+        v.accum = self.accum
+        v.address = self.address
+        return v
 
     def compare_accum(self, other: "Validator") -> "Validator":
         """Higher accum wins; ties break toward the lower address

@@ -24,6 +24,30 @@ def _fixture(n=16):
     return pubs, digs, sigs
 
 
+class TestFieldBounds:
+    def test_fe_ops_correct_at_carried_bound(self):
+        """Regression: fe_mul silently dropped the carry out of product row
+        39 (the two-term 2^260 fold ripples carries one row per round), so
+        inputs with limbs just above 2^13 — legal for 'carried' elements,
+        which the kernel's own bound allows up to M=13000 — miscomputed
+        ~20% of products. Exercise all field ops well past the bound."""
+        import jax.numpy as jnp
+
+        rng = np.random.default_rng(5)
+        for bound in (8192, 13000, 20000):
+            for _ in range(60):
+                a = rng.integers(0, bound, (1, K.NLIMB)).astype(np.uint32)
+                b = rng.integers(0, bound, (1, K.NLIMB)).astype(np.uint32)
+                ia, ib = K.limbs_to_int(a[0]), K.limbs_to_int(b[0])
+                got = np.asarray(K.fe_mul(jnp.asarray(a), jnp.asarray(b)))
+                assert K.limbs_to_int(got[0]) % K.P == ia * ib % K.P, bound
+                assert int(got.max()) <= 13000  # closed under the op set
+                ga = np.asarray(K.fe_add(jnp.asarray(a), jnp.asarray(b)))
+                assert K.limbs_to_int(ga[0]) % K.P == (ia + ib) % K.P
+                gs = np.asarray(K.fe_sub(jnp.asarray(a), jnp.asarray(b)))
+                assert K.limbs_to_int(gs[0]) % K.P == (ia - ib) % K.P
+
+
 class TestKernelParity:
     def test_valid_batch_accepts(self):
         pubs, digs, sigs = _fixture(16)
@@ -81,6 +105,149 @@ class TestKernelParity:
         sigs[3] = s.der_encode_sig(r, sv ^ 1)
         got = K.verify_batch(pubs, digs, sigs, mesh=mesh)
         assert list(got) == [True] * 3 + [False] + [True] * 4
+
+
+try:
+    import jax as _jax
+
+    _TPU = _jax.devices("tpu")[0]
+except Exception:
+    _TPU = None
+
+
+class TestPallasPipeline:
+    """The fused windowed-Straus pallas path (ops/secp256k1_pallas)."""
+
+    def test_row_field_ops_and_complete_addition(self):
+        """Fast component parity for the row-layout (20, B) ops the kernel
+        is built from: field ops at the carried bound, and the complete
+        a=0 addition law against host jacobian math — addition, doubling,
+        and the identity path (digit-0 table entries)."""
+        import jax.numpy as jnp
+        from tendermint_tpu.ops import secp256k1_pallas as sp
+
+        rng = np.random.default_rng(11)
+        ksub = jnp.asarray(sp._K_SUB[:, None])
+
+        def to_rows(v):
+            return jnp.asarray(sp.int_to_limbs(v)[:, None])
+
+        def row_int(r, col=0):
+            return K.limbs_to_int(np.asarray(r)[:, col])
+
+        for bound in (8192, 13000, 20000):
+            for _ in range(40):
+                a = rng.integers(0, bound, (sp.NLIMB, 4)).astype(np.uint32)
+                b = rng.integers(0, bound, (sp.NLIMB, 4)).astype(np.uint32)
+                gm = np.asarray(sp.fe_mul(jnp.asarray(a), jnp.asarray(b)))
+                gs = np.asarray(sp.fe_sub(jnp.asarray(a), jnp.asarray(b), ksub))
+                for c in range(4):
+                    ia, ib = K.limbs_to_int(a[:, c]), K.limbs_to_int(b[:, c])
+                    assert K.limbs_to_int(gm[:, c]) % K.P == ia * ib % K.P
+                    assert K.limbs_to_int(gs[:, c]) % K.P == (ia - ib) % K.P
+
+        one, zero = to_rows(1), to_rows(0)
+        ident = (zero, one, zero)
+        for _ in range(8):
+            k1 = int(rng.integers(1, 1 << 60))
+            k2 = int(rng.integers(1, 1 << 60))
+            A = s._to_affine(s._jmul(s._G, k1))
+            B = s._to_affine(s._jmul(s._G, k2))
+            pa = (to_rows(A[0]), to_rows(A[1]), one)
+            pb = (to_rows(B[0]), to_rows(B[1]), one)
+            for q, ks in ((pb, k1 + k2), (pa, 2 * k1), (ident, k1)):
+                X, _Y, Z = sp.pt_add(pa, q, ksub)
+                zi = pow(row_int(Z) % K.P, K.P - 2, K.P)
+                assert row_int(X) * zi % K.P == s._to_affine(s._jmul(s._G, ks))[0]
+
+    @pytest.mark.slow
+    @pytest.mark.skipif(
+        not __import__("os").environ.get("TM_RUN_SLOW"),
+        reason="CPU jit of the full ladder takes ~10 min (set TM_RUN_SLOW=1)",
+    )
+    def test_ladder_math_matches_oracle(self):
+        """The kernel's exact math — shared ladder_math (digit tables, 4
+        doublings + two complete adds per window) jitted once on CPU over
+        the whole batch; the pallas_call wrapper adds only ref plumbing."""
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+        from tendermint_tpu.ops import secp256k1_pallas as sp
+
+        n = 5
+        pubs, digs, sigs = _fixture(n)
+        # corrupt one signature, wrong-digest another
+        r, sv = s.der_decode_sig(sigs[1])
+        sigs[1] = s.der_encode_sig(r, sv ^ 1)
+        digs[3] = sha256(b"other")
+        want = [s.verify(pubs[i], digs[i], sigs[i]) for i in range(n)]
+
+        qx = np.zeros((sp.NLIMB, n), np.uint32)
+        qy = np.zeros((sp.NLIMB, n), np.uint32)
+        d1 = np.zeros((sp.NWIN, n), np.uint32)
+        d2 = np.zeros((sp.NWIN, n), np.uint32)
+        rs = [0] * n
+        for i in range(n):
+            item = K.prep_item(pubs[i], digs[i], sigs[i])
+            assert item[0] == "kernel"  # fixture sigs all parse
+            _, Q, u1, u2, r_int = item
+            qx[:, i], qy[:, i] = Q[0], Q[1]
+            d1[:, i] = sp._digits_msb(u1)
+            d2[:, i] = sp._digits_msb(u2)
+            rs[i] = r_int
+
+        consts = jnp.asarray(sp._CONSTS)
+
+        @jax.jit
+        def run(qx, qy, d1, d2):
+            return sp.ladder_math(
+                consts, qx, qy,
+                lambda t: lax.dynamic_slice_in_dim(d1, t, 1, axis=0),
+                lambda t: lax.dynamic_slice_in_dim(d2, t, 1, axis=0),
+            )
+
+        X, _Y, Z = run(jnp.asarray(qx), jnp.asarray(qy),
+                       jnp.asarray(d1), jnp.asarray(d2))
+        got = []
+        for i in range(n):
+            z_int = K.limbs_to_int(np.asarray(Z)[:, i]) % K.P
+            if z_int == 0:
+                got.append(False)
+                continue
+            x_aff = (K.limbs_to_int(np.asarray(X)[:, i]) % K.P
+                     * pow(z_int, K.P - 2, K.P)) % K.P
+            got.append(
+                x_aff == rs[i]
+                or (rs[i] + K.N < K.P and x_aff == rs[i] + K.N)
+            )
+        assert got == want
+
+    @pytest.mark.skipif(_TPU is None, reason="needs the real chip")
+    def test_pallas_matches_oracle_on_tpu(self):
+        from tendermint_tpu.ops import secp256k1_pallas as sp
+
+        pubs, digs, sigs = _fixture(40)
+        r, sv = s.der_decode_sig(sigs[7])
+        sigs[7] = s.der_encode_sig(r, sv ^ 1)
+        digs[11] = sha256(b"not the signed digest")
+        got = sp.verify_batch(pubs, digs, sigs, device=_TPU)
+        want = [s.verify(pubs[i], digs[i], sigs[i]) for i in range(40)]
+        assert list(got) == want
+
+    @pytest.mark.slow
+    @pytest.mark.skipif(
+        not __import__("os").environ.get("TM_RUN_SLOW"),
+        reason="interpret-mode ladder takes ~10 min (set TM_RUN_SLOW=1)",
+    )
+    def test_pallas_interpret_parity(self):
+        from tendermint_tpu.ops import secp256k1_pallas as sp
+
+        pubs, digs, sigs = _fixture(6)
+        r, sv = s.der_decode_sig(sigs[1])
+        sigs[1] = s.der_encode_sig(r, sv ^ 1)
+        got = sp.verify_batch(pubs, digs, sigs, interpret=True)
+        want = [s.verify(pubs[i], digs[i], sigs[i]) for i in range(6)]
+        assert list(got) == want
 
 
 class TestBatchVerifierIntegration:
