@@ -1,0 +1,42 @@
+# Developer entrypoints (ref: the reference repo's Makefile targets).
+
+PYTHON ?= python
+
+.PHONY: test test_slow test_sanitizers bench bench_fastsync bench_secp \
+        bench_multisig localnet-start localnet-stop build-docker-localnode
+
+test:
+	$(PYTHON) -m pytest tests/ -q
+
+# interpret-mode pallas ladders + full fuzz sweeps (~30 min)
+test_slow:
+	TM_RUN_SLOW=1 $(PYTHON) -m pytest tests/ -q
+
+# ASAN/UBSAN native builds + checkify kernel sweep (role of `make test_race`)
+test_sanitizers:
+	$(PYTHON) -m pytest tests/test_sanitizers.py -q
+
+bench:
+	$(PYTHON) bench.py
+
+bench_fastsync:
+	$(PYTHON) scripts/bench_fastsync.py 2048 64 512
+
+bench_secp:
+	$(PYTHON) scripts/bench_secp.py 1024
+
+bench_multisig:
+	$(PYTHON) scripts/bench_multisig.py 1000 3 5
+
+build-docker-localnode:
+	docker build -t tendermint_tpu/localnode networks/local/localnode
+
+# Run a 4-node testnet locally (ref Makefile:296)
+localnet-start: localnet-stop build-docker-localnode
+	@if ! [ -f build/node0/config/genesis.json ]; then \
+	  $(PYTHON) -m tendermint_tpu.cmd.tendermint testnet --v 4 \
+	    --output-dir ./build --starting-ip-address 192.167.10.2 ; fi
+	docker-compose up
+
+localnet-stop:
+	docker-compose down

@@ -1,0 +1,16 @@
+#!/usr/bin/env bash
+# Localnode entrypoint (ref: networks/local/localnode/wrapper.sh):
+# runs the node for this container's ID with its generated home tree.
+set -e
+
+ID=${ID:-0}
+LOG=${LOG:-tendermint.log}
+HOME_DIR="/tendermint/node${ID}"
+PEERS=$(cat "${HOME_DIR}/config/peers.txt" 2>/dev/null || true)
+
+exec python -m tendermint_tpu.cmd.tendermint --home "${HOME_DIR}" "$@" \
+  --rpc.laddr tcp://0.0.0.0:26657 \
+  --p2p.laddr tcp://0.0.0.0:26656 \
+  --p2p.persistent_peers "${PEERS}" \
+  --p2p.allow_duplicate_ip true \
+  2>&1 | tee "${HOME_DIR}/../${LOG}"

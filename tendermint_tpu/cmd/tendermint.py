@@ -268,10 +268,21 @@ def cmd_testnet(args) -> int:
         ],
     )
     doc.validate_and_complete()
-    peers = ",".join(
-        f"{nk.id()}@127.0.0.1:{base_port + 2 * i}"
-        for i, nk in enumerate(node_keys)
-    )
+    start_ip = getattr(args, "starting_ip_address", "") or ""
+    if start_ip:
+        # docker-network style: node i at consecutive IPs, one canonical
+        # p2p port (testnet.go --starting-ip-address semantics)
+        import ipaddress
+
+        base_ip = ipaddress.ip_address(start_ip)
+        peers = ",".join(
+            f"{nk.id()}@{base_ip + i}:26656" for i, nk in enumerate(node_keys)
+        )
+    else:
+        peers = ",".join(
+            f"{nk.id()}@127.0.0.1:{base_port + 2 * i}"
+            for i, nk in enumerate(node_keys)
+        )
     for i in range(n):
         doc.save_as(os.path.join(out, f"node{i}", "config", "genesis.json"))
         with open(os.path.join(out, f"node{i}", "config", "peers.txt"), "w") as f:
@@ -343,6 +354,10 @@ def main(argv=None) -> int:
     sp.add_argument("--output-dir", default="./mytestnet")
     sp.add_argument("--chain-id", default="")
     sp.add_argument("--starting-port", dest="starting_port", type=int, default=26656)
+    sp.add_argument(
+        "--starting-ip-address", dest="starting_ip_address", default="",
+        help="peer nodes at consecutive IPs on port 26656 (docker networks)",
+    )
     sp.set_defaults(fn=cmd_testnet)
 
     args = p.parse_args(argv)

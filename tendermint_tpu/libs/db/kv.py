@@ -125,6 +125,10 @@ class MemDB(DB):
                 else:
                     self.delete(k)
 
+    def stats(self) -> Dict[str, str]:
+        with self._mtx:
+            return {"keys": str(len(self._data))}
+
 
 class SQLiteDB(DB):
     """Durable KV on sqlite3 — the framework's disk backend (role of
@@ -249,10 +253,19 @@ class PrefixDB(DB):
         )
 
 
+def _fsdb_factory(name: str, dir: str):
+    import os
+
+    from tendermint_tpu.libs.db.fsdb import FSDB
+
+    return FSDB(os.path.join(dir, f"{name}.db"))
+
+
 _BACKENDS = {
     "memdb": lambda name, dir: MemDB(),
     "sqlite": SQLiteDB,
     "goleveldb": SQLiteDB,  # config-compat alias for the reference's default
+    "fsdb": _fsdb_factory,  # file-per-key (libs/db/fsdb.go)
 }
 
 

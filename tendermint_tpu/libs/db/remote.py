@@ -1,0 +1,280 @@
+"""RemoteDB — the KV store served over gRPC (ref: libs/db/remotedb/ +
+remotedb/grpcdb/: a DB service a node can keep on another machine).
+
+Like the ABCI gRPC transport (abci/grpc.py), no generated protobuf stubs:
+grpc's generic handler API with this framework's deterministic codec as the
+message serializer (wire compatibility with the reference's proto schema is
+a non-goal; the contract — named DBs behind one server, the full DB method
+set over the network — is what's mirrored). Iterators are collected
+server-side and returned in one response rather than streamed: remote
+iteration in the reference exists for operator tooling over bounded ranges,
+and a single framed response keeps the client's DB interface synchronous.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Iterator, Optional, Tuple
+
+import grpc
+
+from tendermint_tpu.encoding.codec import Reader, Writer
+from tendermint_tpu.libs.db.kv import DB, Batch, new_db
+from tendermint_tpu.libs.service import BaseService
+
+_SERVICE = "tendermint.db.RemoteDB"
+_METHODS = ("InitRemote", "Get", "Has", "Set", "SetSync", "Delete",
+            "DeleteSync", "Iterator", "BatchWrite", "Stats")
+
+
+def _enc(*fields) -> bytes:
+    w = Writer()
+    for f in fields:
+        if isinstance(f, bool):
+            w.bool(f)
+        elif isinstance(f, int):
+            w.svarint(f)
+        elif isinstance(f, str):
+            w.string(f)
+        elif f is None:
+            w.bool(False)
+        else:
+            w.bytes(bytes(f))
+    return w.build()
+
+
+def _opt_bytes(w: Writer, b: Optional[bytes]) -> None:
+    if b is None:
+        w.bool(False)
+    else:
+        w.bool(True)
+        w.bytes(b)
+
+
+def _read_opt_bytes(r: Reader) -> Optional[bytes]:
+    return r.bytes() if r.bool() else None
+
+
+class RemoteDBServer(BaseService):
+    """Serves named databases; a client InitRemote(name, type, dir) selects
+    (creating on first use) which one its handle operates on — the handle's
+    identity travels as the name on every call (the reference binds one DB
+    per connection; a name per request is the stateless equivalent)."""
+
+    def __init__(self, addr: str, dir: str = "."):
+        super().__init__("db.RemoteDBServer")
+        self.addr = addr.replace("tcp://", "")
+        self.dir = dir
+        self._dbs: Dict[str, DB] = {}
+        self._mtx = threading.Lock()
+        self._server = None
+        self.bound_port: Optional[int] = None
+
+    def _db(self, name: str) -> DB:
+        with self._mtx:
+            db = self._dbs.get(name)
+            if db is None:
+                raise KeyError(f"remote db {name!r} not initialized")
+            return db
+
+    # -- handlers ----------------------------------------------------------
+    def _init_remote(self, req: bytes) -> bytes:
+        r = Reader(req)
+        name, typ, _dir = r.string(), r.string(), r.string()
+        with self._mtx:
+            if name not in self._dbs:
+                self._dbs[name] = new_db(name, typ, self.dir)
+        return _enc(True)
+
+    def _get(self, req: bytes) -> bytes:
+        r = Reader(req)
+        db = self._db(r.string())
+        v = db.get(r.bytes())
+        w = Writer()
+        _opt_bytes(w, v)
+        return w.build()
+
+    def _has(self, req: bytes) -> bytes:
+        r = Reader(req)
+        db = self._db(r.string())
+        return _enc(bool(db.has(r.bytes())))
+
+    def _set(self, req: bytes, sync: bool) -> bytes:
+        r = Reader(req)
+        db = self._db(r.string())
+        k, v = r.bytes(), r.bytes()
+        (db.set_sync if sync else db.set)(k, v)
+        return _enc(True)
+
+    def _delete(self, req: bytes, sync: bool) -> bytes:
+        r = Reader(req)
+        db = self._db(r.string())
+        (db.delete_sync if sync else db.delete)(r.bytes())
+        return _enc(True)
+
+    def _iterator(self, req: bytes) -> bytes:
+        r = Reader(req)
+        db = self._db(r.string())
+        start = _read_opt_bytes(r)
+        end = _read_opt_bytes(r)
+        reverse = r.bool()
+        w = Writer()
+        pairs = list(db.iterator(start, end, reverse))
+        w.uvarint(len(pairs))
+        for k, v in pairs:
+            w.bytes(k)
+            w.bytes(v)
+        return w.build()
+
+    def _batch_write(self, req: bytes) -> bytes:
+        r = Reader(req)
+        db = self._db(r.string())
+        n = r.uvarint()
+        ops = []
+        for _ in range(n):
+            op = r.string()
+            k = r.bytes()
+            v = r.bytes()
+            ops.append((op, k, v))
+        db.apply_batch(ops)
+        return _enc(True)
+
+    def _stats(self, req: bytes) -> bytes:
+        r = Reader(req)
+        db = self._db(r.string())
+        st = db.stats()
+        w = Writer()
+        w.uvarint(len(st))
+        for k, v in sorted(st.items()):
+            w.string(k)
+            w.string(v)
+        return w.build()
+
+    # -- lifecycle ---------------------------------------------------------
+    def on_start(self) -> None:
+        from concurrent import futures
+
+        dispatch = {
+            "InitRemote": self._init_remote,
+            "Get": self._get,
+            "Has": self._has,
+            "Set": lambda b: self._set(b, sync=False),
+            "SetSync": lambda b: self._set(b, sync=True),
+            "Delete": lambda b: self._delete(b, sync=False),
+            "DeleteSync": lambda b: self._delete(b, sync=True),
+            "Iterator": self._iterator,
+            "BatchWrite": self._batch_write,
+            "Stats": self._stats,
+        }
+
+        def make_handler(fn):
+            def handler(request, context):
+                try:
+                    return fn(request)
+                except Exception as e:
+                    context.abort(grpc.StatusCode.INTERNAL, str(e))
+
+            return handler
+
+        handlers = {
+            name: grpc.unary_unary_rpc_method_handler(
+                make_handler(fn),
+                request_deserializer=lambda b: b,
+                response_serializer=lambda b: b,
+            )
+            for name, fn in dispatch.items()
+        }
+        self._server = grpc.server(futures.ThreadPoolExecutor(max_workers=4))
+        self._server.add_generic_rpc_handlers(
+            (grpc.method_handlers_generic_handler(_SERVICE, handlers),)
+        )
+        self.bound_port = self._server.add_insecure_port(self.addr)
+        if self.bound_port == 0:
+            raise OSError(f"could not bind RemoteDB server to {self.addr}")
+        self._server.start()
+        self.logger.info("RemoteDB server on %s", self.addr)
+
+    def on_stop(self) -> None:
+        if self._server is not None:
+            self._server.stop(grace=1.0)
+        with self._mtx:
+            for db in self._dbs.values():
+                try:
+                    db.close()
+                except Exception:
+                    pass
+
+
+class RemoteDB(DB):
+    """Client handle implementing the DB interface against a RemoteDBServer
+    (ref remotedb.go NewRemoteDB + InitRemote)."""
+
+    def __init__(self, addr: str, name: str, backend: str = "memdb",
+                 dir: str = ".", timeout: float = 10.0):
+        self.addr = addr.replace("tcp://", "")
+        self.name = name
+        self._timeout = timeout
+        self._channel = grpc.insecure_channel(self.addr)
+        grpc.channel_ready_future(self._channel).result(timeout=timeout)
+        self._stubs = {
+            m: self._channel.unary_unary(
+                f"/{_SERVICE}/{m}",
+                request_serializer=lambda b: b,
+                response_deserializer=lambda b: b,
+            )
+            for m in _METHODS
+        }
+        self._call("InitRemote", _enc(name, backend, dir))
+
+    def _call(self, method: str, payload: bytes) -> bytes:
+        return self._stubs[method](payload, timeout=self._timeout)
+
+    # -- DB interface ------------------------------------------------------
+    def get(self, key: bytes) -> Optional[bytes]:
+        resp = self._call("Get", _enc(self.name, key))
+        return _read_opt_bytes(Reader(resp))
+
+    def has(self, key: bytes) -> bool:
+        return Reader(self._call("Has", _enc(self.name, key))).bool()
+
+    def set(self, key: bytes, value: bytes) -> None:
+        self._call("Set", _enc(self.name, key, value))
+
+    def set_sync(self, key: bytes, value: bytes) -> None:
+        self._call("SetSync", _enc(self.name, key, value))
+
+    def delete(self, key: bytes) -> None:
+        self._call("Delete", _enc(self.name, key))
+
+    def delete_sync(self, key: bytes) -> None:
+        self._call("DeleteSync", _enc(self.name, key))
+
+    def iterator(
+        self, start: Optional[bytes] = None, end: Optional[bytes] = None,
+        reverse: bool = False,
+    ) -> Iterator[Tuple[bytes, bytes]]:
+        w = Writer()
+        w.string(self.name)
+        _opt_bytes(w, start)
+        _opt_bytes(w, end)
+        w.bool(reverse)
+        r = Reader(self._call("Iterator", w.build()))
+        n = r.uvarint()
+        return iter([(r.bytes(), r.bytes()) for _ in range(n)])
+
+    def apply_batch(self, ops) -> None:
+        w = Writer()
+        w.string(self.name)
+        w.uvarint(len(ops))
+        for op, k, v in ops:
+            w.string(op)
+            w.bytes(k)
+            w.bytes(v if v is not None else b"")
+        self._call("BatchWrite", w.build())
+
+    def close(self) -> None:
+        self._channel.close()
+
+    def stats(self) -> Dict[str, str]:
+        r = Reader(self._call("Stats", _enc(self.name)))
+        return {r.string(): r.string() for _ in range(r.uvarint())}

@@ -115,15 +115,16 @@ class LiteProxy:
                 except ProviderError:
                     pass
                 if at_pin is None:
-                    import logging
-
-                    logging.getLogger("lite.proxy").warning(
-                        "trust store has no entry at pinned height %d — the "
-                        "pin cannot be cross-checked against the existing "
-                        "store; reset the trust DB to re-anchor",
-                        self.trusted_height,
+                    # an unverifiable pin must FAIL, not warn: the very
+                    # threat the pin exists for is a TOFU-poisoned store,
+                    # and proceeding would serve that chain as verified
+                    raise ProviderError(
+                        f"trust store has no entry at pinned height "
+                        f"{self.trusted_height}, so the pin cannot be "
+                        f"verified against it — reset the lite trust DB to "
+                        f"re-anchor from the pin"
                     )
-                elif at_pin.signed_header.header.hash() != self.trusted_hash:
+                if at_pin.signed_header.header.hash() != self.trusted_hash:
                     raise ProviderError(
                         f"trust store conflicts with the pinned hash at "
                         f"height {self.trusted_height} — reset the lite "

@@ -46,7 +46,7 @@ class TestService:
 
 
 class TestDB:
-    @pytest.mark.parametrize("mk", ["memdb", "sqlite"])
+    @pytest.mark.parametrize("mk", ["memdb", "sqlite", "fsdb"])
     def test_crud_and_iteration(self, mk, tmp_path):
         db = new_db("test", mk, str(tmp_path))
         db.set(b"b", b"2")
@@ -83,6 +83,62 @@ class TestDB:
         db = MemDB()
         db.batch().set(b"x", b"1").set(b"y", b"2").delete(b"x").write()
         assert db.get(b"x") is None and db.get(b"y") == b"2"
+
+    def test_fsdb_durability_and_odd_keys(self, tmp_path):
+        """fsdb.go semantics: file-per-key, escaped names, survives reopen."""
+        from tendermint_tpu.libs.db.fsdb import FSDB
+
+        db = FSDB(str(tmp_path / "fs"))
+        odd = b"a/b \x00%.key"  # path separators, spaces, NUL, percent
+        db.set_sync(odd, b"v1")
+        db.set(b"plain", b"v2")
+        assert db.get(odd) == b"v1"
+        db2 = FSDB(str(tmp_path / "fs"))  # reopen: files are the store
+        assert db2.get(odd) == b"v1" and db2.get(b"plain") == b"v2"
+        assert [k for k, _ in db2.iterator()] == sorted([odd, b"plain"])
+        db2.delete(odd)
+        assert not db2.has(odd)
+
+    def test_fsdb_key_named_like_tmp_file(self, tmp_path):
+        """Regression: writing key b'foo' via temp file 'foo.tmp' used to
+        destroy the data of an actual key b'foo.tmp'."""
+        from tendermint_tpu.libs.db.fsdb import FSDB
+
+        db = FSDB(str(tmp_path / "fs"))
+        db.set(b"foo.tmp", b"v1")
+        db.set(b"foo", b"v2")
+        assert db.get(b"foo.tmp") == b"v1"
+        assert db.get(b"foo") == b"v2"
+        assert sorted(k for k, _ in db.iterator()) == [b"foo", b"foo.tmp"]
+        assert db.stats()["keys"] == "2"
+
+    def test_remotedb_over_grpc(self, tmp_path):
+        """RemoteDB client against a RemoteDBServer — the full DB interface
+        over the wire (ref libs/db/remotedb/remotedb_test.go)."""
+        from tendermint_tpu.libs.db.remote import RemoteDB, RemoteDBServer
+
+        srv = RemoteDBServer("127.0.0.1:0", dir=str(tmp_path))
+        srv.start()
+        try:
+            db = RemoteDB(f"127.0.0.1:{srv.bound_port}", "t1", "memdb")
+            db.set(b"b", b"2")
+            db.set_sync(b"a", b"1")
+            db.set(b"c", b"3")
+            assert db.get(b"b") == b"2" and db.get(b"zz") is None
+            assert db.has(b"a") and not db.has(b"zz")
+            db.delete(b"b")
+            assert list(db.iterator()) == [(b"a", b"1"), (b"c", b"3")]
+            assert list(db.iterator(reverse=True)) == [(b"c", b"3"), (b"a", b"1")]
+            assert list(db.iterator(start=b"b")) == [(b"c", b"3")]
+            db.apply_batch([("set", b"x", b"9"), ("delete", b"a", b"")])
+            assert db.get(b"x") == b"9" and db.get(b"a") is None
+            assert int(db.stats()["keys"]) == 2
+            # named isolation: a second handle sees its own store
+            db2 = RemoteDB(f"127.0.0.1:{srv.bound_port}", "t2", "memdb")
+            assert db2.get(b"x") is None
+            db.close(), db2.close()
+        finally:
+            srv.stop()
 
 
 class TestAutofile:

@@ -143,6 +143,28 @@ class TestLiteProxy:
             )
             with pytest.raises(ProviderError):
                 wrong.status()
+
+            # a pin against an EXISTING store: matching entry passes,
+            # missing entry fails loudly (a TOFU-poisoned store must not
+            # silently win over the operator's pin)
+            shared_db = None
+            from tendermint_tpu.libs.db.kv import MemDB
+
+            shared_db = MemDB()
+            seeded = LiteProxy("lite-proxy-chain", addr, trust_db=shared_db)
+            seeded.status()  # TOFU-seeds the shared store at height 1
+            h1 = node.block_store.load_block_meta(1).block_id.hash
+            repinned_ok = LiteProxy(
+                "lite-proxy-chain", addr, trust_db=shared_db,
+                trusted_height=1, trusted_hash=h1,
+            )
+            assert repinned_ok.status()["verified"]
+            repinned_missing = LiteProxy(
+                "lite-proxy-chain", addr, trust_db=shared_db,
+                trusted_height=3, trusted_hash=b"\x13" * 32,
+            )
+            with pytest.raises(ProviderError):
+                repinned_missing.status()
         finally:
             node.stop()
 
