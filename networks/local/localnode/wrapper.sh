@@ -8,9 +8,11 @@ LOG=${LOG:-tendermint.log}
 HOME_DIR="/tendermint/node${ID}"
 PEERS=$(cat "${HOME_DIR}/config/peers.txt" 2>/dev/null || true)
 
+# log PER NODE — all containers share the /tendermint volume, so a shared
+# path would have four tee processes truncating each other
 exec python -m tendermint_tpu.cmd.tendermint --home "${HOME_DIR}" "$@" \
   --rpc.laddr tcp://0.0.0.0:26657 \
   --p2p.laddr tcp://0.0.0.0:26656 \
   --p2p.persistent_peers "${PEERS}" \
   --p2p.allow_duplicate_ip true \
-  2>&1 | tee "${HOME_DIR}/../${LOG}"
+  2>&1 | tee "${HOME_DIR}/${LOG}"

@@ -66,6 +66,7 @@ class RemoteDBServer(BaseService):
         self.addr = addr.replace("tcp://", "")
         self.dir = dir
         self._dbs: Dict[str, DB] = {}
+        self._backends: Dict[str, str] = {}
         self._mtx = threading.Lock()
         self._server = None
         self.bound_port: Optional[int] = None
@@ -78,12 +79,38 @@ class RemoteDBServer(BaseService):
             return db
 
     # -- handlers ----------------------------------------------------------
+    _NAME_RE = None  # compiled lazily
+
     def _init_remote(self, req: bytes) -> bytes:
+        import re
+
         r = Reader(req)
-        name, typ, _dir = r.string(), r.string(), r.string()
+        # the client's dir is part of the reference protocol shape but the
+        # SERVER owns placement: every store lives under self.dir
+        name, typ, _client_dir = r.string(), r.string(), r.string()
+        if RemoteDBServer._NAME_RE is None:
+            RemoteDBServer._NAME_RE = re.compile(r"^[A-Za-z0-9_][A-Za-z0-9._-]*$")
+        # the name becomes a path component — an unauthenticated client must
+        # not be able to traverse outside the server's data dir
+        if (
+            not RemoteDBServer._NAME_RE.match(name)
+            or ".." in name
+            or len(name) > 128
+        ):
+            raise ValueError(f"invalid remote db name {name!r}")
         with self._mtx:
-            if name not in self._dbs:
+            existing = self._backends.get(name)
+            if existing is not None:
+                if existing != typ:
+                    # silently handing a memdb to a client that asked for a
+                    # durable backend loses data with no error anywhere
+                    raise ValueError(
+                        f"remote db {name!r} already initialized with "
+                        f"backend {existing!r}, not {typ!r}"
+                    )
+            else:
                 self._dbs[name] = new_db(name, typ, self.dir)
+                self._backends[name] = typ
         return _enc(True)
 
     def _get(self, req: bytes) -> bytes:

@@ -86,6 +86,75 @@ class TestVerifyBlockWindow:
         n_ok, err = verify_block_window(st, blocks)
         assert (n_ok, err) == (0, None)
 
+    def test_window_truncates_at_valset_change_and_full_chain_applies(self):
+        """Fast-sync through validator-set churn: a window spanning a valset
+        change must truncate at the boundary (not fail), and the verify→
+        apply pipeline must walk the whole chain — re-verifying post-change
+        heights under the NEW set (reactor.go:306 semantics across sets)."""
+        import base64
+
+        from tendermint_tpu.abci.examples.kvstore import PersistentKVStoreApp
+        from tendermint_tpu.crypto.keys import PrivKeyEd25519
+        from tendermint_tpu.state.execution import BlockExecutor
+        from tendermint_tpu.types import BlockID, MockPV
+
+        joiners = [MockPV(PrivKeyEd25519.generate(bytes([77 + i]) * 32))
+                   for i in range(2)]
+
+        def on_height(h, st):
+            if h == 5:  # takes effect at h7 (height + 2)
+                return [
+                    b"val:" + base64.b64encode(pv.get_pub_key().bytes())
+                    + b"!50"
+                    for pv in joiners
+                ]
+            return []
+
+        fx = build_chain(
+            n_vals=4, n_heights=12, chain_id="churn-sync",
+            app_factory=PersistentKVStoreApp, on_height=on_height,
+            extra_pvs=joiners,
+        )
+        blocks = [fx.block_store.load_block(h) for h in range(1, 13)]
+
+        # fresh executor from genesis, one big window over everything
+        st = state_from_genesis(fx.genesis)
+        db = MemDB()
+        sm_store.save_state(db, st)
+        conn = MultiAppConn(LocalClientCreator(PersistentKVStoreApp()))
+        conn.start()
+        block_exec = BlockExecutor(db, conn.consensus)
+
+        applied = 0
+        pos = 0
+        rounds = 0
+        while pos < len(blocks) - 1:
+            window = blocks[pos:]
+            parts_list = []
+            n_ok, err = verify_block_window(
+                st, window, parts_out=parts_list
+            )
+            assert err is None, f"round {rounds}: {err}"
+            assert n_ok > 0
+            if pos == 0:
+                # the valset changes at height 7: the first window (heights
+                # 1..12) must truncate to exactly 6 verified blocks
+                assert n_ok == 6, n_ok
+            for i in range(n_ok):
+                block = window[i]
+                block_id = BlockID(
+                    hash=block.hash(), parts_header=parts_list[i].header()
+                )
+                st = block_exec.apply_block(
+                    st, block_id, block, trusted_last_commit=True
+                )
+                applied += 1
+            pos += n_ok
+            rounds += 1
+        assert applied == 11  # the final block's commit lives in block 13
+        assert st.validators.size == 6  # churn really happened
+        assert rounds >= 2  # pipeline crossed the valset boundary
+
 
 # ---------------------------------------------------------------------------
 # BlockPool

@@ -136,7 +136,19 @@ class TestDB:
             # named isolation: a second handle sees its own store
             db2 = RemoteDB(f"127.0.0.1:{srv.bound_port}", "t2", "memdb")
             assert db2.get(b"x") is None
-            db.close(), db2.close()
+            # path traversal in the name is rejected server-side
+            import grpc as _grpc
+
+            with pytest.raises(_grpc.RpcError):
+                RemoteDB(f"127.0.0.1:{srv.bound_port}", "../../evil", "fsdb")
+            # re-init with a DIFFERENT backend must not silently hand over
+            # the existing (possibly non-durable) store
+            with pytest.raises(_grpc.RpcError):
+                RemoteDB(f"127.0.0.1:{srv.bound_port}", "t1", "fsdb")
+            # same-backend re-init is fine (reconnect case)
+            db3 = RemoteDB(f"127.0.0.1:{srv.bound_port}", "t1", "memdb")
+            assert db3.get(b"x") == b"9"
+            db.close(), db2.close(), db3.close()
         finally:
             srv.stop()
 
