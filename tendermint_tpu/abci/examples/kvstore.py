@@ -82,6 +82,11 @@ class KVStoreApp(abci.Application):
                 height=self.height,
                 log="exists" if value else "does not exist",
             )
+        if req.path.startswith("/p2p/filter/"):
+            # admit every peer (the reference kvstore never dispatches on
+            # path, so filter queries get the zero — OK — code; apps with
+            # real policies override this)
+            return abci.ResponseQuery(code=abci.CODE_TYPE_OK)
         return abci.ResponseQuery(code=1, log=f"unknown path {req.path}")
 
 

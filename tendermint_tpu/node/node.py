@@ -296,7 +296,54 @@ class Node(BaseService):
             channels=channels,
             moniker=config.base.moniker,
         )
-        transport = MultiplexTransport(node_info, self.node_key)
+        # ABCI peer filtering (node.go:383-421): the app vetoes peers by
+        # address at connection time and by authenticated node ID after the
+        # handshake, via /p2p/filter/... queries — OK code admits
+        conn_filters = []
+        peer_filters = []
+        if config.base.filter_peers:
+            from tendermint_tpu.abci import types as abci_t
+
+            FILTER_TIMEOUT = 5.0  # node.go filterTimeout: a stalled app
+            # query must not wedge the accept loop — time out and reject
+
+            def _abci_filter(path_prefix: str):
+                def f(value: str):
+                    import queue as _q
+                    import threading as _t
+
+                    out: "_q.Queue" = _q.Queue(1)
+
+                    def run():
+                        try:
+                            out.put(self.proxy_app.query.query_sync(
+                                abci_t.RequestQuery(
+                                    path=f"{path_prefix}/{value}"
+                                )
+                            ))
+                        except Exception as e:  # surfaced as rejection
+                            out.put(e)
+
+                    _t.Thread(target=run, daemon=True,
+                              name="abci-peer-filter").start()
+                    try:
+                        res = out.get(timeout=FILTER_TIMEOUT)
+                    except _q.Empty:
+                        return "filter query timed out"
+                    if isinstance(res, Exception):
+                        return f"filter query failed: {res}"
+                    if res.code != abci_t.CODE_TYPE_OK:
+                        return f"rejected by app (code {res.code})"
+                    return None
+
+                return f
+
+            conn_filters.append(_abci_filter("/p2p/filter/addr"))
+            peer_filters.append(_abci_filter("/p2p/filter/id"))
+
+        transport = MultiplexTransport(
+            node_info, self.node_key, conn_filters=conn_filters
+        )
         self.switch = Switch(
             transport,
             SwitchConfig(
@@ -305,6 +352,7 @@ class Node(BaseService):
                 allow_duplicate_ip=config.p2p.allow_duplicate_ip,
             ),
             mconfig,
+            peer_filters=peer_filters,
         )
         self.switch.add_reactor("consensus", self.consensus_reactor)
         self.switch.add_reactor("blockchain", self.blockchain_reactor)

@@ -29,6 +29,16 @@ class SwitchConnectToSelfError(P2PError):
         self.addr = addr
 
 
+class SwitchPeerFilteredError(P2PError):
+    """Peer rejected by an admission filter (node.go peerFilters — e.g. the
+    app's /p2p/filter/id ABCI query said no)."""
+
+    def __init__(self, peer_id: str, reason: str):
+        super().__init__(f"peer {peer_id} filtered: {reason}")
+        self.peer_id = peer_id
+        self.reason = reason
+
+
 class TransportClosedError(P2PError):
     pass
 

@@ -30,6 +30,7 @@ from tendermint_tpu.p2p.errors import (
     SwitchConnectToSelfError,
     SwitchDuplicatePeerIDError,
     SwitchDuplicatePeerIPError,
+    SwitchPeerFilteredError,
     TransportClosedError,
 )
 from tendermint_tpu.p2p.netaddress import NetAddress
@@ -63,11 +64,15 @@ class Switch(BaseService):
         transport: MultiplexTransport,
         config: Optional[SwitchConfig] = None,
         mconfig: Optional[MConnConfig] = None,
+        peer_filters=None,  # callables (node_id) -> rejection reason or None
     ):
         super().__init__(name="Switch")
         self.transport = transport
         self.config = config or SwitchConfig()
         self.mconfig = mconfig or MConnConfig()
+        # post-handshake admission filters by authenticated node ID
+        # (node.go:401-419 peerFilters — e.g. the ABCI /p2p/filter/id query)
+        self.peer_filters = list(peer_filters or [])
         self.peers = PeerSet()
         self.reactors: Dict[str, Reactor] = {}
         self._chan_descs: List[ChannelDescriptor] = []
@@ -252,6 +257,11 @@ class Switch(BaseService):
         ):
             up.conn.close()
             raise SwitchDuplicatePeerIPError(up.socket_addr.host)
+        for pf in self.peer_filters:
+            reason = pf(up.node_info.id)
+            if reason:
+                up.conn.close()
+                raise SwitchPeerFilteredError(up.node_info.id, reason)
 
         peer = Peer(
             up.conn,

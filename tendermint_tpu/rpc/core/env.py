@@ -511,6 +511,48 @@ class RPCEnv:
         jax.profiler.stop_trace()
         return {"tracing": False}
 
+    # reference route-name aliases (routes.go:49-51): the CPU profiler maps
+    # to the JAX/xprof trace (device+host timelines), the heap profile to a
+    # tracemalloc snapshot
+    def unsafe_start_cpu_profiler(self, filename: str = "/tmp/tm_tpu_trace") -> dict:
+        return self.unsafe_start_profiler(dir=filename)
+
+    def unsafe_stop_cpu_profiler(self) -> dict:
+        return self.unsafe_stop_profiler()
+
+    def unsafe_write_heap_profile(self, filename: str = "/tmp/tm_tpu_heap.txt") -> dict:
+        """Top allocation sites by live bytes (pprof WriteHeapProfile's
+        role; tracemalloc is the Python-native equivalent)."""
+        self._require_unsafe()
+        import tracemalloc
+
+        started_here = False
+        if not tracemalloc.is_tracing():
+            # no baseline: start now so a SECOND call sees real traffic
+            tracemalloc.start()
+            started_here = True
+        snap = tracemalloc.take_snapshot()
+        stats = snap.statistics("lineno")[:100]
+        with open(filename, "w") as f:
+            for st in stats:
+                f.write(f"{st.size}B in {st.count} blocks: {st.traceback}\n")
+        return {
+            "filename": filename,
+            "top_entries": len(stats),
+            "tracing_started_now": started_here,
+        }
+
+    def unsafe_stop_heap_profiler(self) -> dict:
+        """Turn allocation tracing back off — tracemalloc taxes every
+        allocation, so a validator must be able to disable it without a
+        restart after grabbing profiles."""
+        self._require_unsafe()
+        import tracemalloc
+
+        was = tracemalloc.is_tracing()
+        tracemalloc.stop()
+        return {"was_tracing": was}
+
     def abci_info(self) -> dict:
         res = self.node.proxy_app.query.info_sync(abci.RequestInfo())
         return {

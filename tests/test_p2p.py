@@ -386,6 +386,34 @@ class TestSwitch:
         finally:
             stop_switches(sws)
 
+    def test_peer_filter_rejects_by_node_id(self):
+        """Admission filters veto peers by authenticated ID after the
+        handshake (node.go:401-419 peerFilters; the node wires the app's
+        /p2p/filter/id ABCI query through this hook)."""
+        sw_a = make_switch(0, init_switch=lambda i, s: s.add_reactor("echo", EchoReactor()) and s)
+        sw_b = make_switch(1, init_switch=lambda i, s: s.add_reactor("echo", EchoReactor()) and s)
+        # a filters out exactly b's node id
+        sw_a.peer_filters.append(
+            lambda nid: "on the blocklist" if nid == sw_b.node_id else None
+        )
+        sw_a.start(), sw_b.start()
+        try:
+            from tendermint_tpu.p2p.errors import SwitchPeerFilteredError
+
+            with pytest.raises(SwitchPeerFilteredError):
+                connect_switches(sw_a, sw_b)
+            assert sw_a.peers.size() == 0
+            # the filter is directional state on A; an unfiltered pair works
+            sw_c = make_switch(2, init_switch=lambda i, s: s.add_reactor("echo", EchoReactor()) and s)
+            sw_c.start()
+            try:
+                connect_switches(sw_a, sw_c)
+                assert sw_a.peers.has(sw_c.node_id)
+            finally:
+                sw_c.stop()
+        finally:
+            sw_a.stop(), sw_b.stop()
+
     def test_duplicate_channel_id_rejected(self):
         sw = make_switch(init_switch=lambda i, s: s.add_reactor("a", EchoReactor()) and s)
         with pytest.raises(ValueError):

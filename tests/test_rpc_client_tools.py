@@ -96,6 +96,18 @@ class TestHTTPClient:
     def test_unsafe_flush_mempool(self, client):
         client.unsafe_flush_mempool()
 
+    def test_unsafe_heap_profile_route(self, client, tmp_path):
+        out = str(tmp_path / "heap.txt")
+        res = client.call("unsafe_write_heap_profile", filename=out)
+        assert res["filename"] == out
+        import os
+
+        assert os.path.exists(out)
+        # tracing is stoppable without a restart (it taxes every allocation)
+        stop = client.call("unsafe_stop_heap_profiler")
+        assert stop["was_tracing"] is True
+        assert client.call("unsafe_stop_heap_profiler")["was_tracing"] is False
+
     def test_dial_routes_require_switch(self, client):
         # live_node runs without p2p; the route must gate cleanly, not crash
         with pytest.raises(RPCClientError):
