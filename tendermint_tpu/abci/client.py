@@ -131,6 +131,7 @@ class SocketClient(BaseService):
         self._sock: Optional[socket.socket] = None
         self._pending: "queue.Queue[ReqRes]" = queue.Queue()
         self._send_q: "queue.Queue[ReqRes]" = queue.Queue()
+        self._req_mtx = threading.Lock()
         self._err: Optional[Exception] = None
         self._global_cb: Optional[Callable[[Any, Any], None]] = None
         self._must_connect = must_connect
@@ -189,8 +190,13 @@ class SocketClient(BaseService):
 
     def request_async(self, req: Any) -> ReqRes:
         rr = ReqRes(req)
-        self._pending.put(rr)
-        self._send_q.put(rr)
+        # the two enqueues must be ATOMIC: concurrent callers (peer filters,
+        # RPC abci_query, mempool) interleaving them would make _recv_loop
+        # pair responses with the wrong requests — an admit/deny answer
+        # could reach the wrong peer-filter query
+        with self._req_mtx:
+            self._pending.put(rr)
+            self._send_q.put(rr)
         return rr
 
     def request_sync(self, req: Any, timeout: float = 10.0) -> Any:

@@ -61,7 +61,8 @@ class MultiplexTransport(BaseService):
         conn_filters: Optional[List[Callable[[str], Optional[str]]]] = None,
         accept_queue_size: int = 64,
     ):
-        """conn_filters: callables ip -> rejection reason or None."""
+        """conn_filters: callables "ip:port" -> rejection reason or None
+        (full remote address, matching the reference's filter protocol)."""
         super().__init__(name="MultiplexTransport")
         self.node_info = node_info
         self.node_key = node_key
@@ -111,7 +112,9 @@ class MultiplexTransport(BaseService):
         the accept loop (reference upgrades concurrently too, transport.go:232)."""
         try:
             for f in self.conn_filters:
-                reason = f(peer_addr[0])
+                # full ip:port, matching the reference's filter protocol
+                # (node.go queries /p2p/filter/addr/<RemoteAddr().String()>)
+                reason = f(f"{peer_addr[0]}:{peer_addr[1]}")
                 if reason:
                     raise RejectedError(reason, is_filtered=True)
             conn, ni = self._upgrade(sock, dialed_id=None)
@@ -160,7 +163,7 @@ class MultiplexTransport(BaseService):
         )
         try:
             for f in self.conn_filters:
-                reason = f(addr.host)
+                reason = f(f"{addr.host}:{addr.port}")
                 if reason:
                     raise RejectedError(reason, is_filtered=True)
             conn, ni = self._upgrade(sock, dialed_id=addr.id)

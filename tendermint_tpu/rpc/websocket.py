@@ -324,6 +324,8 @@ class WSSession:
                         return
                     self.wfile.write(make_frame(OP_TEXT, data))
                     self.wfile.flush()
-            except OSError:
+            except (OSError, ValueError):
+                # ValueError: writing to a file the handler already closed —
+                # a racing client disconnect, same meaning as a broken pipe
                 self._closed.set()
                 return
