@@ -162,8 +162,12 @@ class MultiplexTransport(BaseService):
             (addr.host, addr.port), timeout=DIAL_TIMEOUT
         )
         try:
+            # filter on the RESOLVED remote address (getpeername), not the
+            # configured hostname — the accept path sees numeric ip:port, and
+            # a blocklist must match a dialed peer the same way
+            peer = sock.getpeername()
             for f in self.conn_filters:
-                reason = f(f"{addr.host}:{addr.port}")
+                reason = f(f"{peer[0]}:{peer[1]}")
                 if reason:
                     raise RejectedError(reason, is_filtered=True)
             conn, ni = self._upgrade(sock, dialed_id=addr.id)

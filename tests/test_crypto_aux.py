@@ -153,6 +153,20 @@ class TestArmor:
             armor.decode_armor("-----BEGIN A-----\n\nAAAA\n-----END B-----")
 
 
+class TestRandom:
+    def test_crand_bytes_and_hex(self):
+        from tendermint_tpu.crypto import random as crand
+
+        a, b = crand.c_rand_bytes(32), crand.c_rand_bytes(32)
+        assert len(a) == 32 and a != b
+        assert crand.c_rand_bytes(0) == b""
+        h = crand.c_rand_hex(11)
+        assert len(h) == 11 and all(c in "0123456789abcdef" for c in h)
+        crand.mix_entropy(b"operator entropy")  # API parity, accepted
+        with pytest.raises(ValueError):
+            crand.c_rand_bytes(-1)
+
+
 class TestBech32:
     def test_reference_shape_roundtrip(self):
         """bech32_test.go: sha256 digest through ConvertAndEncode/back."""
