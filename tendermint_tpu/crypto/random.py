@@ -28,7 +28,9 @@ def c_rand_bytes(n: int) -> bytes:
 
 
 def c_rand_hex(n_digits: int) -> str:
-    """random.go:72 CRandHex: n hex digits of CSPRNG output."""
+    """random.go:72 CRandHex — with one deliberate divergence: the reference
+    hex-encodes n/2 bytes, so CRandHex(11) returns 10 chars; this returns
+    exactly n digits (the extra nibble comes from one more CSPRNG byte)."""
     if n_digits < 0:
         raise ValueError("negative digit count")
     return os.urandom((n_digits + 1) // 2).hex()[:n_digits]

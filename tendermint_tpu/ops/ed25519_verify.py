@@ -111,17 +111,27 @@ def fe_sub(a, b):
 
 
 def fe_mul(a, b):
-    """Schoolbook product via 20 shifted multiply-accumulates, then reduce."""
+    """Schoolbook product via 20 shifted multiply-accumulates, then reduce.
+
+    Bounds (audited; regression-pinned in tests/test_ops_ed25519.py):
+    carried inputs have limbs ≤ ~8800 (fe_sub's limb-0 wraparound term is
+    the max — see fe_carry), and fe_mul is proven well past that (stressed
+    to 13000). The 41st product row is REQUIRED: carries ripple one row
+    per round, so with a 40-limb buffer the carry out of row 39 — reachable
+    at the margin, e.g. top limbs 8192·8192 = 2^26 — would be silently
+    dropped (the same mechanism as the secp bug fixed in
+    secp256k1_verify.fe_mul). Row 40 folds as 2^520 ≡ 608² (mod p)."""
     shape = jnp.broadcast_shapes(a.shape[:-1], b.shape[:-1])
-    prod = jnp.zeros(shape + (2 * NLIMB,), dtype=jnp.uint32)
+    prod = jnp.zeros(shape + (2 * NLIMB + 1,), dtype=jnp.uint32)
     for i in range(NLIMB):
         prod = prod.at[..., i : i + NLIMB].add(a[..., i : i + 1] * b)
-    # local carries inside the 40-limb product (no wrap needed: value < 2^520)
+    # local carries inside the 41-limb product (no wrap needed: value < 2^520)
     for _ in range(3):
         c = prod >> BITS
         prod = (prod & MASK).at[..., 1:].add(c[..., :-1])
-    # fold limbs 20..39 down: 2^(260+13j) ≡ 608·2^13j
-    lo = prod[..., :NLIMB] + prod[..., NLIMB:] * FOLD
+    # fold limbs 20..39 down (2^(260+13j) ≡ 608·2^13j), row 40 as 608²
+    lo = prod[..., :NLIMB] + prod[..., NLIMB : 2 * NLIMB] * FOLD
+    lo = lo.at[..., 0].add(prod[..., 2 * NLIMB] * (FOLD * FOLD))
     return fe_carry(lo, rounds=4)
 
 

@@ -33,6 +33,75 @@ def _verify(backend, pubs, msgs, sigs):
     return kernel.verify_batch(pubs, msgs, sigs)
 
 
+def _limbs_to_int(l):
+    import numpy as np
+
+    return sum(int(v) << (13 * i) for i, v in enumerate(np.asarray(l)))
+
+
+class TestFieldBounds:
+    """Pin the two kernels' (different!) field-arithmetic contracts.
+
+    XLA kernel: carried limbs reach ~8800 (fe_sub's limb-0 wraparound),
+    and fe_mul must hold well past that — its 41st product row guards the
+    top-carry drop (same mechanism as the secp bug fixed in
+    secp256k1_verify.fe_mul), which was reachable at the margin
+    (top limbs 8192·8192 = 2^26 exactly).
+    Pallas kernel: proven to M = 13000 in its header; checked past it."""
+
+    def test_xla_ops_correct_well_past_carried_bound(self):
+        import numpy as np
+        import jax.numpy as jnp
+
+        rng = np.random.default_rng(5)
+        for bound in (8192, 8800, 13000):
+            for _ in range(60):
+                a = rng.integers(0, bound, (1, kernel.NLIMB)).astype(np.uint32)
+                b = rng.integers(0, bound, (1, kernel.NLIMB)).astype(np.uint32)
+                ia, ib = _limbs_to_int(a[0]), _limbs_to_int(b[0])
+                gm = np.asarray(kernel.fe_mul(jnp.asarray(a), jnp.asarray(b)))
+                ga = np.asarray(kernel.fe_add(jnp.asarray(a), jnp.asarray(b)))
+                gs = np.asarray(kernel.fe_sub(jnp.asarray(a), jnp.asarray(b)))
+                assert _limbs_to_int(gm[0]) % kernel.P == ia * ib % kernel.P
+                assert _limbs_to_int(ga[0]) % kernel.P == (ia + ib) % kernel.P
+                assert _limbs_to_int(gs[0]) % kernel.P == (ia - ib) % kernel.P
+
+    def test_xla_fe_mul_top_carry_margin_case(self):
+        """Regression for the dropped row-39 carry: top limbs 8192·8192
+        hit 2^26 exactly, whose carry a 40-limb buffer silently lost."""
+        import numpy as np
+        import jax.numpy as jnp
+
+        a = np.zeros((1, kernel.NLIMB), np.uint32)
+        b = np.zeros((1, kernel.NLIMB), np.uint32)
+        a[0, kernel.NLIMB - 1] = 8192
+        b[0, kernel.NLIMB - 1] = 8192
+        got = np.asarray(kernel.fe_mul(jnp.asarray(a), jnp.asarray(b)))
+        want = (_limbs_to_int(a[0]) * _limbs_to_int(b[0])) % kernel.P
+        assert _limbs_to_int(got[0]) % kernel.P == want
+
+    def test_pallas_row_ops_correct_at_documented_bound(self):
+        import numpy as np
+        import jax.numpy as jnp
+
+        from tendermint_tpu.ops import ed25519_pallas as ep
+
+        rng = np.random.default_rng(6)
+        ksub = jnp.asarray(ep._K_SUB[:, None].astype(np.uint32))
+        for bound in (8192, 13000, 14000):
+            for _ in range(40):
+                a = rng.integers(0, bound, (ep.NLIMB, 4)).astype(np.uint32)
+                b = rng.integers(0, bound, (ep.NLIMB, 4)).astype(np.uint32)
+                gm = np.asarray(ep.fe_mul(jnp.asarray(a), jnp.asarray(b)))
+                ga = np.asarray(ep.fe_add(jnp.asarray(a), jnp.asarray(b)))
+                gs = np.asarray(ep.fe_sub(jnp.asarray(a), jnp.asarray(b), ksub))
+                for c in range(4):
+                    ia, ib = _limbs_to_int(a[:, c]), _limbs_to_int(b[:, c])
+                    assert _limbs_to_int(gm[:, c]) % ep.P == ia * ib % ep.P
+                    assert _limbs_to_int(ga[:, c]) % ep.P == (ia + ib) % ep.P
+                    assert _limbs_to_int(gs[:, c]) % ep.P == (ia - ib) % ep.P
+
+
 def _mk(n, msg_len=110, seed0=1):
     """n valid (pub, msg, sig) triples."""
     pubs, msgs, sigs = [], [], []
