@@ -2,8 +2,9 @@
 
 PYTHON ?= python
 
-.PHONY: test test_slow test_sanitizers bench bench_fastsync bench_secp \
-        bench_multisig localnet-start localnet-stop build-docker-localnode
+.PHONY: test test_slow test_sanitizers bench bench-local bench_fastsync \
+        bench_secp bench_multisig localnet-start localnet-stop \
+        build-docker-localnode
 
 test:
 	$(PYTHON) -m pytest tests/ -q
@@ -18,6 +19,10 @@ test_sanitizers:
 
 bench:
 	$(PYTHON) bench.py
+
+# regenerate BENCH_LOCAL.md (the committed perf ledger) from every bench
+bench-local:
+	$(PYTHON) scripts/bench_ledger.py
 
 bench_fastsync:
 	$(PYTHON) scripts/bench_fastsync.py 2048 64 512
@@ -35,7 +40,7 @@ build-docker-localnode:
 localnet-start: localnet-stop build-docker-localnode
 	@if ! [ -f build/node0/config/genesis.json ]; then \
 	  $(PYTHON) -m tendermint_tpu.cmd.tendermint testnet --v 4 \
-	    --output-dir ./build --starting-ip-address 192.167.10.2 ; fi
+	    --output-dir ./build --starting-ip-address 192.168.10.2 ; fi
 	docker-compose up
 
 localnet-stop:

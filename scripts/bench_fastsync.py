@@ -14,6 +14,10 @@ Prints one JSON line: {"metric": "fastsync_replay", "value": blocks/s, ...}
 --sweep instead re-runs the verify+apply pipeline over a ladder of window
 sizes and prints one JSON line per window (how VERIFY_WINDOW's default was
 chosen — blockchain/reactor.py:46).
+--null-verify swaps in a free all-true verifier: the resulting blocks/s is
+the HOST PIPELINE CEILING (sign-bytes assembly, packing, apply, store) that
+bounds end-to-end throughput no matter how fast the device verifies — the
+number the window-size sweep is judged by on machines without the chip.
 """
 
 import json
@@ -23,14 +27,27 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-N_BLOCKS = int(sys.argv[1]) if len(sys.argv) > 1 else 2048
-N_VALS = int(sys.argv[2]) if len(sys.argv) > 2 else 64
+_pos = [a for a in sys.argv[1:] if not a.startswith("--")]
+N_BLOCKS = int(_pos[0]) if len(_pos) > 0 else 2048
+N_VALS = int(_pos[1]) if len(_pos) > 1 else 64
+WINDOW = int(_pos[2]) if len(_pos) > 2 else 512
 SWEEP = "--sweep" in sys.argv
-WINDOW = (
-    int(sys.argv[3]) if len(sys.argv) > 3 and sys.argv[3] != "--sweep" else 512
-)
+NULL_VERIFY = "--null-verify" in sys.argv
 SWEEP_WINDOWS = [16, 64, 128, 256, 512, 1024]
 BASELINE_SAMPLE_BLOCKS = 64  # serial blocks to time (extrapolated)
+
+
+class NullVerifier:
+    """All-true, zero-cost: isolates the host pipeline ceiling."""
+
+    name = "null"
+
+    def verify_ed25519(self, items):
+        import numpy as np
+
+        return np.ones((len(items),), dtype=bool)
+
+    verify_secp256k1 = verify_ed25519
 
 
 def _fresh_executor(genesis):
@@ -73,7 +90,9 @@ def main():
     )
 
     # --- baseline: reference-shaped serial loop (verify every commit on host,
-    # then apply) over a sample, extrapolated ---
+    # then apply) over a sample, extrapolated.  With --null-verify both sides
+    # get the free verifier so the comparison isolates pipeline shape. ---
+    base_verifier = NullVerifier() if NULL_VERIFY else HostBatchVerifier()
     st, block_exec = _fresh_executor(fx.genesis)
     sample = min(BASELINE_SAMPLE_BLOCKS, N_BLOCKS - 1)
     t0 = time.perf_counter()
@@ -83,19 +102,22 @@ def main():
         block_id = BlockID(hash=block.hash(), parts_header=parts.header())
         st.validators.verify_commit(
             fx.chain_id, block_id, block.height, next_block.last_commit,
-            verifier=HostBatchVerifier(),
+            verifier=base_verifier,
         )
         st = block_exec.apply_block(st, block_id, block, trusted_last_commit=True)
     baseline_s = (time.perf_counter() - t0) * (N_BLOCKS / sample)
     print(
-        f"# baseline (serial host verify): "
+        f"# baseline (serial {base_verifier.name} verify): "
         f"{N_BLOCKS / baseline_s:.0f} blocks/s", file=sys.stderr,
     )
 
     # --- ours: windowed batched verify + apply ---
-    # TM_BATCH_VERIFIER=host skips device construction entirely (a dead
-    # TPU tunnel hangs backend discovery, not errors)
-    if os.environ.get("TM_BATCH_VERIFIER", "").lower() == "host":
+    # TM_BATCH_VERIFIER=host skips device construction entirely (and
+    # TPUBatchVerifier itself probes tunnel liveness in a subprocess before
+    # any in-process discovery — libs/tpu_probe)
+    if NULL_VERIFY:
+        verifier = NullVerifier()
+    elif os.environ.get("TM_BATCH_VERIFIER", "").lower() == "host":
         verifier = HostBatchVerifier()
     else:
         try:
@@ -137,20 +159,26 @@ def main():
     )
 
     base_rate = N_BLOCKS / baseline_s
+    tag = "_null" if NULL_VERIFY else ""
     if SWEEP:
-        for w in SWEEP_WINDOWS:
+        from tendermint_tpu.blockchain.reactor import auto_verify_window
+
+        auto_w = auto_verify_window(N_VALS)
+        for w in sorted(set(SWEEP_WINDOWS + [auto_w])):
             if w >= N_BLOCKS:
                 continue
             rate = run_pipeline(w)
             print(
                 json.dumps(
                     {
-                        "metric": f"fastsync_replay_{N_BLOCKS}x{N_VALS}_w{w}",
+                        "metric": f"fastsync_replay{tag}_{N_BLOCKS}x{N_VALS}_w{w}",
                         "value": round(rate, 1),
                         "unit": "blocks/s",
                         "vs_baseline": round(rate / base_rate, 2),
+                        "auto_window": auto_w,
                     }
-                )
+                ),
+                flush=True,
             )
         return
 
@@ -158,10 +186,11 @@ def main():
     print(
         json.dumps(
             {
-                "metric": f"fastsync_replay_{N_BLOCKS}x{N_VALS}",
+                "metric": f"fastsync_replay{tag}_{N_BLOCKS}x{N_VALS}",
                 "value": round(ours_rate, 1),
                 "unit": "blocks/s",
                 "vs_baseline": round(ours_rate / base_rate, 2),
+                "verifier": verifier.name if hasattr(verifier, "name") else "?",
             }
         )
     )

@@ -1,0 +1,172 @@
+"""Regenerate BENCH_LOCAL.md — the committed perf ledger.
+
+Runs every host/device benchmark in scripts/ as a subprocess with a hard
+timeout (a dead TPU tunnel must cost a section, not the ledger) and rewrites
+BENCH_LOCAL.md with the JSON lines each produced.  Perf claims in this repo
+live HERE, not in commit messages.
+
+Usage: python scripts/bench_ledger.py [--fast]
+  --fast skips the big-valset sweeps (~2 min saved)
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OUT = os.path.join(_REPO, "BENCH_LOCAL.md")
+PY = sys.executable
+
+FAST = "--fast" in sys.argv
+
+SECTIONS = [
+    (
+        "Host micro-benchmarks",
+        "codec / WAL decode / mempool reap / proposal sign+verify "
+        "(refs: benchmarks/codec_test.go:30, consensus/wal_test.go:163, "
+        "mempool/bench_test.go:11, types/proposal_test.go:77)",
+        [PY, "scripts/bench_micro.py"],
+        300,
+    ),
+    (
+        "Fast-sync replay — host pipeline ceiling (free verifier)",
+        "verify_block_window packing + apply with verification cost zeroed; "
+        "bounds end-to-end blocks/s (ref: benchmarks/blockchain/localsync.sh)",
+        [PY, "scripts/bench_fastsync.py", "512", "64", "512", "--null-verify"],
+        420,
+    ),
+    (
+        "Fast-sync replay — end to end (default verifier)",
+        "host backend when the TPU tunnel is down; device windows when up",
+        [PY, "scripts/bench_fastsync.py", "512", "64", "512"],
+        600,
+    ),
+    (
+        "Window sweep 64 validators (free verifier)",
+        "window-size ladder justifying VERIFY_WINDOW "
+        "(blockchain/reactor.py:51)",
+        [PY, "scripts/bench_fastsync.py", "512", "64", "--sweep",
+         "--null-verify"],
+        600,
+    ),
+]
+
+if not FAST:
+    SECTIONS += [
+        (
+            "Window sweep 1,024 validators (free verifier)",
+            "MAX_WINDOW_SIGS caps the auto window at 512 here",
+            [PY, "scripts/bench_fastsync.py", "192", "1024", "--sweep",
+             "--null-verify"],
+            600,
+        ),
+        (
+            "Window sweep 10,000 validators (free verifier)",
+            "MAX_WINDOW_SIGS caps the auto window at 52 here "
+            "(blockchain/reactor.py:52)",
+            [PY, "scripts/bench_fastsync.py", "48", "10000", "--sweep",
+             "--null-verify"],
+            900,
+        ),
+    ]
+
+SECTIONS += [
+    (
+        "secp256k1 batch verify",
+        "windowed-Straus kernel vs host (scripts/bench_secp.py)",
+        [PY, "scripts/bench_secp.py"],
+        600,
+    ),
+    (
+        "multisig batch verify",
+        "threshold aggregates flattened into the device batch "
+        "(scripts/bench_multisig.py)",
+        [PY, "scripts/bench_multisig.py"],
+        600,
+    ),
+    (
+        "Headline commit verify (bench.py)",
+        "10k-validator production verify_commit + fastsync field; "
+        "device wall+p50 when the tunnel is up",
+        [PY, "bench.py"],
+        1200,
+    ),
+]
+
+
+def _run(cmd, timeout):
+    t0 = time.perf_counter()
+    try:
+        res = subprocess.run(
+            cmd, cwd=_REPO, capture_output=True, text=True, timeout=timeout
+        )
+        lines = [
+            ln for ln in res.stdout.splitlines() if ln.strip().startswith("{")
+        ]
+        rows = []
+        for ln in lines:
+            try:
+                rows.append(json.loads(ln))
+            except ValueError:
+                pass
+        status = "ok" if res.returncode == 0 and rows else f"rc={res.returncode}"
+    except subprocess.TimeoutExpired:
+        rows, status = [], f"timeout>{timeout}s"
+    return rows, status, time.perf_counter() - t0
+
+
+def main():
+    import datetime
+    import platform
+
+    rev = subprocess.run(
+        ["git", "rev-parse", "--short", "HEAD"], cwd=_REPO,
+        capture_output=True, text=True,
+    ).stdout.strip()
+    tunnel = os.environ.get("TM_AXON_ALIVE", "unprobed")
+    parts = [
+        "# BENCH_LOCAL — committed perf ledger",
+        "",
+        "Regenerate with `make bench-local` (or `python scripts/"
+        "bench_ledger.py`).  Every row is a JSON line captured from the "
+        "named bench script run as a subprocess under a hard timeout; "
+        "sections that need the TPU tunnel degrade or time out without it.",
+        "",
+        f"- generated: {datetime.datetime.now(datetime.timezone.utc):%Y-%m-%d %H:%M} UTC",
+        f"- git: `{rev}`",
+        f"- host: {platform.processor() or platform.machine()}, "
+        f"python {platform.python_version()}",
+        f"- TM_AXON_ALIVE at start: {tunnel}",
+        "",
+    ]
+    for title, desc, cmd, timeout in SECTIONS:
+        print(f"== {title}: {' '.join(cmd[1:])}", file=sys.stderr, flush=True)
+        rows, status, dt = _run(cmd, timeout)
+        parts.append(f"## {title}")
+        parts.append("")
+        parts.append(f"{desc}  \n`{' '.join(os.path.relpath(c, _REPO) if os.sep in c else c for c in cmd)}` — {status}, {dt:.0f}s")
+        parts.append("")
+        if rows:
+            keys = ["metric", "value", "unit", "vs_baseline"]
+            extra = sorted(
+                {k for r in rows for k in r} - set(keys)
+            )
+            cols = keys + extra
+            parts.append("| " + " | ".join(cols) + " |")
+            parts.append("|" + "---|" * len(cols))
+            for r in rows:
+                parts.append(
+                    "| " + " | ".join(str(r.get(k, "")) for k in cols) + " |"
+                )
+        else:
+            parts.append("_no data captured_")
+        parts.append("")
+    with open(OUT, "w") as f:
+        f.write("\n".join(parts))
+    print(f"wrote {OUT}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

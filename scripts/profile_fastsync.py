@@ -1,0 +1,100 @@
+"""Where does the fast-sync host millisecond go?
+
+Runs the windowed verify→apply pipeline with a FREE (all-true) verifier so
+every profiled microsecond is host-pipeline overhead — sign-bytes assembly,
+part sets, ABCI round-trips, state-store writes — and prints the top
+cumulative-time functions plus a blocks/s ceiling.  This is the measurement
+behind the host-path optimisation work (the device verify rides on top; the
+host ceiling bounds end-to-end blocks/s).
+
+Usage: python scripts/profile_fastsync.py [n_blocks] [n_vals] [window]
+"""
+
+import cProfile
+import io
+import os
+import pstats
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+N_BLOCKS = int(sys.argv[1]) if len(sys.argv) > 1 else 512
+N_VALS = int(sys.argv[2]) if len(sys.argv) > 2 else 64
+WINDOW = int(sys.argv[3]) if len(sys.argv) > 3 else 512
+
+
+class FreeVerifier:
+    """All-true: verification cost = 0, so the profile is pure host overhead."""
+
+    name = "free"
+
+    def verify_ed25519(self, items):
+        import numpy as np
+
+        return np.ones((len(items),), dtype=bool)
+
+    def verify_secp256k1(self, items):
+        import numpy as np
+
+        return np.ones((len(items),), dtype=bool)
+
+
+def main():
+    from tendermint_tpu.crypto import batch as _batch
+    from tendermint_tpu.crypto.batch import HostBatchVerifier
+    from tendermint_tpu.blockchain.reactor import verify_block_window
+    from tendermint_tpu.testutil.chain import build_chain
+    from tendermint_tpu.types import BlockID
+
+    _batch.set_batch_verifier(HostBatchVerifier())
+
+    t0 = time.perf_counter()
+    fx = build_chain(n_vals=N_VALS, n_heights=N_BLOCKS, chain_id="prof-sync")
+    print(f"# chain built in {time.perf_counter() - t0:.1f}s", file=sys.stderr)
+    blocks = [fx.block_store.load_block(h) for h in range(1, N_BLOCKS + 1)]
+
+    from scripts.bench_fastsync import _fresh_executor
+
+    verifier = FreeVerifier()
+
+    def run_pipeline():
+        st, block_exec = _fresh_executor(fx.genesis)
+        t0 = time.perf_counter()
+        applied = 0
+        pos = 0
+        while pos < N_BLOCKS - 1:
+            window = blocks[pos : pos + WINDOW + 1]
+            parts_list = []
+            n_ok, err = verify_block_window(
+                st, window, verifier=verifier, parts_out=parts_list
+            )
+            if err is not None or n_ok == 0:
+                raise SystemExit(f"verification failed at {pos}: {err}")
+            for i in range(n_ok):
+                block = window[i]
+                block_id = BlockID(
+                    hash=block.hash(), parts_header=parts_list[i].header()
+                )
+                st = block_exec.apply_block(
+                    st, block_id, block, trusted_last_commit=True
+                )
+                applied += 1
+            pos += n_ok
+        return applied / (time.perf_counter() - t0)
+
+    rate = run_pipeline()  # warm
+    print(f"# warm rate: {rate:.0f} blocks/s ({1e3 / rate:.3f} ms/block)")
+
+    prof = cProfile.Profile()
+    prof.enable()
+    rate = run_pipeline()
+    prof.disable()
+    print(f"# profiled rate: {rate:.0f} blocks/s ({1e3 / rate:.3f} ms/block)")
+    s = io.StringIO()
+    pstats.Stats(prof, stream=s).sort_stats("cumulative").print_stats(45)
+    print(s.getvalue())
+
+
+if __name__ == "__main__":
+    main()

@@ -68,6 +68,7 @@ _METHODS = {
     abci.RequestEndBlock: "end_block",
     abci.RequestCommit: "commit",
 }
+_REQ_BY_STEM = {v: k for k, v in _METHODS.items()}
 
 
 class LocalClient(BaseService):
@@ -100,7 +101,12 @@ class LocalClient(BaseService):
         return rr
 
     def request_sync(self, req: Any) -> Any:
-        return self.request_async(req).response
+        # no ReqRes handle: the call completes inline, so the future-like
+        # wrapper is pure allocation on the three-sync-calls-per-block path
+        res = self._call(req)
+        if self._global_cb:
+            self._global_cb(req, res)
+        return res
 
     def flush_sync(self) -> None:
         pass
@@ -112,11 +118,14 @@ class LocalClient(BaseService):
     def __getattr__(self, name: str):
         if name.endswith("_sync") or name.endswith("_async"):
             stem, _, kind = name.rpartition("_")
-            req_cls = {v: k for k, v in _METHODS.items()}.get(stem)
+            req_cls = _REQ_BY_STEM.get(stem)
             if req_cls is not None:
                 if kind == "sync":
-                    return lambda req=None: self.request_sync(req or req_cls())
-                return lambda req=None: self.request_async(req or req_cls())
+                    fn = lambda req=None: self.request_sync(req or req_cls())
+                else:
+                    fn = lambda req=None: self.request_async(req or req_cls())
+                setattr(self, name, fn)  # memoize: __getattr__ runs per miss
+                return fn
         raise AttributeError(name)
 
 
@@ -213,11 +222,14 @@ class SocketClient(BaseService):
     def __getattr__(self, name: str):
         if name.endswith("_sync") or name.endswith("_async"):
             stem, _, kind = name.rpartition("_")
-            req_cls = {v: k for k, v in _METHODS.items()}.get(stem)
+            req_cls = _REQ_BY_STEM.get(stem)
             if req_cls is not None:
                 if kind == "sync":
-                    return lambda req=None: self.request_sync(req or req_cls())
-                return lambda req=None: self.request_async(req or req_cls())
+                    fn = lambda req=None: self.request_sync(req or req_cls())
+                else:
+                    fn = lambda req=None: self.request_async(req or req_cls())
+                setattr(self, name, fn)
+                return fn
         raise AttributeError(name)
 
 

@@ -269,7 +269,16 @@ def cmd_testnet(args) -> int:
     )
     doc.validate_and_complete()
     start_ip = getattr(args, "starting_ip_address", "") or ""
-    if start_ip:
+    host_prefix = getattr(args, "hostname_prefix", "") or ""
+    if host_prefix:
+        # kubernetes StatefulSet style: pod i is reachable at
+        # <prefix>-<i>.<prefix> via the headless service
+        # (testnet.go --hostname-prefix semantics; networks/kubernetes/)
+        peers = ",".join(
+            f"{nk.id()}@{host_prefix}-{i}.{host_prefix}:26656"
+            for i, nk in enumerate(node_keys)
+        )
+    elif start_ip:
         # docker-network style: node i at consecutive IPs, one canonical
         # p2p port (testnet.go --starting-ip-address semantics)
         import ipaddress
@@ -354,6 +363,11 @@ def main(argv=None) -> int:
     sp.add_argument("--output-dir", default="./mytestnet")
     sp.add_argument("--chain-id", default="")
     sp.add_argument("--starting-port", dest="starting_port", type=int, default=26656)
+    sp.add_argument(
+        "--hostname-prefix", dest="hostname_prefix", default="",
+        help="peer addresses become <prefix>-<i>.<prefix>:26656 "
+             "(kubernetes StatefulSet DNS; see networks/kubernetes/)",
+    )
     sp.add_argument(
         "--starting-ip-address", dest="starting_ip_address", default="",
         help="peer nodes at consecutive IPs on port 26656 (docker networks)",

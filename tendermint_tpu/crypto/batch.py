@@ -63,13 +63,16 @@ class HostBatchVerifier:
 
 
 def _find_tpu_device():
-    """The real chip, if reachable (even when the default backend is CPU)."""
-    import jax
+    """The real chip, if reachable (even when the default backend is CPU).
 
-    try:
-        return jax.devices("tpu")[0]
-    except Exception:
-        return None
+    Never performs jax device discovery in-process before a subprocess
+    liveness probe has passed: on a wedged tunnel, discovery HANGS rather
+    than erroring, which would freeze a validator at its first commit
+    verify.  libs/tpu_probe holds the probe + cache; a dead verdict also
+    pins this process to the CPU platform so the XLA fallback stays safe."""
+    from tendermint_tpu.libs.tpu_probe import safe_tpu_device
+
+    return safe_tpu_device()
 
 
 class TPUBatchVerifier:
@@ -92,6 +95,15 @@ class TPUBatchVerifier:
             self._tpu = _find_tpu_device()
             if self._tpu is None:
                 raise RuntimeError("pallas backend requires a reachable TPU")
+        elif backend == "xla" and mesh is None:
+            # The XLA fallback touches jax at first dispatch; on a dead
+            # tunnel that discovery would hang, so probe now (cached) and
+            # pin the CPU platform when the chip is unreachable.  A caller
+            # passing a mesh already performed discovery to build it.
+            from tendermint_tpu.libs.tpu_probe import pin_cpu_platform, tpu_alive
+
+            if not tpu_alive():
+                pin_cpu_platform()
         self.backend = backend
         # deferred imports: keep jax out of pure-host users
         if backend == "pallas":
@@ -202,6 +214,17 @@ def verify_generic(
     if verifier is None:
         verifier = get_batch_verifier()
     n = len(pubkeys)
+    # Homogeneous ed25519 batch — every fast-sync window and almost every
+    # commit in practice.  Skip the per-item dispatch bookkeeping below
+    # (isinstance + three index lists over |window|×|valset| items was a
+    # measurable slice of the host ms/block ceiling).
+    if all(type(pk) is PubKeyEd25519 for pk in pubkeys) and all(
+        len(s) == 64 for s in sigs
+    ):
+        items = [
+            SigItem(pk.bytes(), m, s) for pk, m, s in zip(pubkeys, msgs, sigs)
+        ]
+        return np.asarray(verifier.verify_ed25519(items), dtype=bool)
     out = np.zeros((n,), dtype=bool)
     ed_idx: List[Tuple[int, int]] = []  # (result index, position in ed_items)
     ed_items: List[SigItem] = []

@@ -148,8 +148,10 @@ class PubKeyMultisigThreshold(PubKey):
         if len(multisig.sigs) < self.k:
             return False
         # adversarial bytes can flag more signers than signatures supplied —
-        # reject instead of indexing out of range (the reference would panic)
-        if multisig.bitarray.count() != len(multisig.sigs):
+        # reject instead of indexing out of range (the reference would panic).
+        # count < len(sigs) (unused trailing sigs) stays ACCEPTED: the
+        # reference only indexes flagged entries and never looks at the rest
+        if multisig.bitarray.count() > len(multisig.sigs):
             return False
         # each flagged signer must verify (threshold_pubkey.go:41-55)
         sig_index = 0
@@ -173,8 +175,8 @@ class PubKeyMultisigThreshold(PubKey):
             return None
         if len(multisig.sigs) < self.k:
             return None
-        if multisig.bitarray.count() != len(multisig.sigs):
-            return None  # mirrors verify_bytes' mismatch rejection
+        if multisig.bitarray.count() > len(multisig.sigs):
+            return None  # mirrors verify_bytes' out-of-range rejection
         out = []
         sig_index = 0
         for i in range(len(self.pubkeys)):

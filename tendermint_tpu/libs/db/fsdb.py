@@ -24,7 +24,13 @@ class FSDB(DB):
 
     # -- paths -------------------------------------------------------------
     def _path(self, key: bytes) -> str:
-        return os.path.join(self._dir, urllib.parse.quote_from_bytes(bytes(key), safe=""))
+        name = urllib.parse.quote_from_bytes(bytes(key), safe="")
+        # quote() leaves '.' unescaped, so the keys b"." / b".." would
+        # resolve to the directory itself / its parent — escape any all-dots
+        # name (round-trips fine: unquote maps %2E back to '.')
+        if name and set(name) == {"."}:
+            name = name.replace(".", "%2E")
+        return os.path.join(self._dir, name)
 
     @staticmethod
     def _unescape(name: str) -> bytes:

@@ -13,6 +13,7 @@ and a single framed response keeps the client's DB interface synchronous.
 
 from __future__ import annotations
 
+import hmac
 import threading
 from typing import Dict, Iterator, Optional, Tuple
 
@@ -55,16 +56,58 @@ def _read_opt_bytes(r: Reader) -> Optional[bytes]:
     return r.bytes() if r.bool() else None
 
 
+class _TokenAuthInterceptor(grpc.ServerInterceptor):
+    """Rejects any call whose `authorization` metadata doesn't carry the
+    shared bearer token (constant-time compare).  The reference secures this
+    exact surface with credentialed dials (grpcdb.go:31-41 TLS cert/key);
+    the token is the transport-independent half — TLS wraps the channel
+    below when cert/key are configured."""
+
+    def __init__(self, token: str):
+        self._want = f"Bearer {token}".encode()
+
+        def _deny(request, context):
+            context.abort(
+                grpc.StatusCode.UNAUTHENTICATED, "missing or invalid db token"
+            )
+
+        self._deny_handler = grpc.unary_unary_rpc_method_handler(
+            _deny,
+            request_deserializer=lambda b: b,
+            response_serializer=lambda b: b,
+        )
+
+    def intercept_service(self, continuation, handler_call_details):
+        for key, value in handler_call_details.invocation_metadata or ():
+            if key == "authorization":
+                got = value.encode() if isinstance(value, str) else value
+                if hmac.compare_digest(got, self._want):
+                    return continuation(handler_call_details)
+                break
+        return self._deny_handler
+
+
 class RemoteDBServer(BaseService):
     """Serves named databases; a client InitRemote(name, type, dir) selects
     (creating on first use) which one its handle operates on — the handle's
     identity travels as the name on every call (the reference binds one DB
-    per connection; a name per request is the stateless equivalent)."""
+    per connection; a name per request is the stateless equivalent).
 
-    def __init__(self, addr: str, dir: str = "."):
+    auth_token: required bearer token; None serves unauthenticated (loopback
+    dev only).  tls_cert/tls_key: PEM file paths — when given the port is a
+    TLS port (clients pass the CA cert as tls_ca), matching the reference's
+    credentialed listener (remotedb/grpcdb/grpcdb.go ListenAndServe)."""
+
+    def __init__(self, addr: str, dir: str = ".",
+                 auth_token: Optional[str] = None,
+                 tls_cert: Optional[str] = None,
+                 tls_key: Optional[str] = None):
         super().__init__("db.RemoteDBServer")
         self.addr = addr.replace("tcp://", "")
         self.dir = dir
+        self.auth_token = auth_token
+        self.tls_cert = tls_cert
+        self.tls_key = tls_key
         self._dbs: Dict[str, DB] = {}
         self._backends: Dict[str, str] = {}
         self._mtx = threading.Lock()
@@ -211,15 +254,31 @@ class RemoteDBServer(BaseService):
             )
             for name, fn in dispatch.items()
         }
-        self._server = grpc.server(futures.ThreadPoolExecutor(max_workers=4))
+        interceptors = (
+            (_TokenAuthInterceptor(self.auth_token),) if self.auth_token else ()
+        )
+        self._server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=4), interceptors=interceptors
+        )
         self._server.add_generic_rpc_handlers(
             (grpc.method_handlers_generic_handler(_SERVICE, handlers),)
         )
-        self.bound_port = self._server.add_insecure_port(self.addr)
+        if self.tls_cert and self.tls_key:
+            with open(self.tls_key, "rb") as f:
+                key_pem = f.read()
+            with open(self.tls_cert, "rb") as f:
+                cert_pem = f.read()
+            creds = grpc.ssl_server_credentials(((key_pem, cert_pem),))
+            self.bound_port = self._server.add_secure_port(self.addr, creds)
+        else:
+            self.bound_port = self._server.add_insecure_port(self.addr)
         if self.bound_port == 0:
             raise OSError(f"could not bind RemoteDB server to {self.addr}")
         self._server.start()
-        self.logger.info("RemoteDB server on %s", self.addr)
+        self.logger.info(
+            "RemoteDB server on %s (auth=%s, tls=%s)",
+            self.addr, bool(self.auth_token), bool(self.tls_cert),
+        )
 
     def on_stop(self) -> None:
         if self._server is not None:
@@ -237,24 +296,42 @@ class RemoteDB(DB):
     (ref remotedb.go NewRemoteDB + InitRemote)."""
 
     def __init__(self, addr: str, name: str, backend: str = "memdb",
-                 dir: str = ".", timeout: float = 10.0):
+                 dir: str = ".", timeout: float = 10.0,
+                 auth_token: Optional[str] = None,
+                 tls_ca: Optional[str] = None):
         self.addr = addr.replace("tcp://", "")
         self.name = name
         self._timeout = timeout
-        self._channel = grpc.insecure_channel(self.addr)
-        grpc.channel_ready_future(self._channel).result(timeout=timeout)
-        self._stubs = {
-            m: self._channel.unary_unary(
-                f"/{_SERVICE}/{m}",
-                request_serializer=lambda b: b,
-                response_deserializer=lambda b: b,
-            )
-            for m in _METHODS
-        }
-        self._call("InitRemote", _enc(name, backend, dir))
+        self._metadata = (
+            (("authorization", f"Bearer {auth_token}"),) if auth_token else ()
+        )
+        if tls_ca:
+            with open(tls_ca, "rb") as f:
+                creds = grpc.ssl_channel_credentials(root_certificates=f.read())
+            self._channel = grpc.secure_channel(self.addr, creds)
+        else:
+            self._channel = grpc.insecure_channel(self.addr)
+        try:
+            grpc.channel_ready_future(self._channel).result(timeout=timeout)
+            self._stubs = {
+                m: self._channel.unary_unary(
+                    f"/{_SERVICE}/{m}",
+                    request_serializer=lambda b: b,
+                    response_deserializer=lambda b: b,
+                )
+                for m in _METHODS
+            }
+            self._call("InitRemote", _enc(name, backend, dir))
+        except BaseException:
+            # a failed handshake/auth must not leak the live channel — a
+            # reconnect-with-backoff caller would accumulate fds forever
+            self._channel.close()
+            raise
 
     def _call(self, method: str, payload: bytes) -> bytes:
-        return self._stubs[method](payload, timeout=self._timeout)
+        return self._stubs[method](
+            payload, timeout=self._timeout, metadata=self._metadata
+        )
 
     # -- DB interface ------------------------------------------------------
     def get(self, key: bytes) -> Optional[bytes]:

@@ -42,6 +42,11 @@ class KnownAddress:
     last_attempt: float = 0.0
     last_success: float = 0.0
     bucket_type: str = "new"  # "new" | "old"
+    # monotonic twin of last_attempt for interval math (a wall-clock step
+    # backwards must not freeze redials); NOT persisted — 0.0 after a load
+    # means "never attempted this process lifetime", which only re-dials
+    # sooner, never later
+    last_attempt_mono: float = 0.0
 
     def to_json(self) -> dict:
         return {
@@ -122,6 +127,7 @@ class AddrBook:
             if ka is not None:
                 ka.attempts += 1
                 ka.last_attempt = time.time()
+                ka.last_attempt_mono = time.monotonic()
                 if ka.attempts >= MAX_ATTEMPTS and ka.bucket_type == "new":
                     self._by_id.pop(addr.id, None)  # hopeless: drop
 

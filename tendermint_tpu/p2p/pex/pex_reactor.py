@@ -243,13 +243,16 @@ class PEXReactor(Reactor):
         sw = self.switch
         if sw is None:
             return
-        now = time.time()
+        now = time.monotonic()
         infos = sorted(self.book.list_known(), key=lambda k: k.last_attempt)
         dials = 0
         for ka in infos:
             if dials >= MAX_CRAWL_DIALS_PER_PASS:
                 break  # the 30s crawl period amortizes the backlog
-            if now - ka.last_attempt < self.crawl_interval:
+            # throttle on the monotonic twin — a wall clock stepping back
+            # must not block redials for the step's length (the persisted
+            # wall stamp still orders the crawl queue above)
+            if ka.last_attempt_mono and now - ka.last_attempt_mono < self.crawl_interval:
                 continue
             addr = ka.addr
             if not addr.id or addr.id == sw.node_id or sw.peers.has(addr.id):

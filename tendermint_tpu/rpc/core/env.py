@@ -7,6 +7,7 @@ Every handler returns JSON-able dicts.  Errors raise RPCError(code, message).
 from __future__ import annotations
 
 import base64
+import os
 import queue
 import threading
 import time
@@ -520,11 +521,37 @@ class RPCEnv:
     def unsafe_stop_cpu_profiler(self) -> dict:
         return self.unsafe_stop_profiler()
 
-    def unsafe_write_heap_profile(self, filename: str = "/tmp/tm_tpu_heap.txt") -> dict:
+    def unsafe_write_heap_profile(self, filename: str = "tm_tpu_heap.txt") -> dict:
         """Top allocation sites by live bytes (pprof WriteHeapProfile's
-        role; tracemalloc is the Python-native equivalent)."""
+        role; tracemalloc is the Python-native equivalent).
+
+        `filename` is a bare name resolved under the system temp dir — an
+        RPC caller must not get an arbitrary-file-overwrite primitive out of
+        a profiling route (rpc.unsafe gating alone is thin: operators do
+        enable it to profile)."""
         self._require_unsafe()
+        import tempfile
         import tracemalloc
+
+        base = os.path.basename(filename)
+        if base != filename or base in ("", ".", ".."):
+            raise ValueError(
+                "heap profile filename must be a bare file name "
+                "(written under the node's profile directory)"
+            )
+        # node-owned 0700 subdir + O_NOFOLLOW: a world-writable /tmp must
+        # not let another local user plant a symlink where we write
+        prof_dir = os.path.join(
+            tempfile.gettempdir(), f"tm-tpu-profiles-{os.getuid()}"
+        )
+        os.makedirs(prof_dir, mode=0o700, exist_ok=True)
+        os.chmod(prof_dir, 0o700)
+        filename = os.path.join(prof_dir, base)
+        fd = os.open(
+            filename,
+            os.O_WRONLY | os.O_CREAT | os.O_TRUNC | os.O_NOFOLLOW,
+            0o600,
+        )
 
         started_here = False
         if not tracemalloc.is_tracing():
@@ -533,7 +560,7 @@ class RPCEnv:
             started_here = True
         snap = tracemalloc.take_snapshot()
         stats = snap.statistics("lineno")[:100]
-        with open(filename, "w") as f:
+        with os.fdopen(fd, "w") as f:
             for st in stats:
                 f.write(f"{st.size}B in {st.count} blocks: {st.traceback}\n")
         return {

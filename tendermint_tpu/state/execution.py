@@ -230,16 +230,17 @@ def _get_begin_block_validator_info(
         precommits = block.last_commit.precommits
         n_pc = len(precommits)
         # read validators in place — get_by_index's defensive copy is pure
-        # allocation on this per-block loop
-        for i, val in enumerate(last_val_set.validators):
-            pc = precommits[i] if i < n_pc else None
-            votes.append(
-                abci.VoteInfo(
-                    address=val.address,
-                    power=val.voting_power,
-                    signed_last_block=pc is not None,
-                )
+        # allocation on this per-block loop (positional args: this builds
+        # |valset| objects per applied block)
+        _vi = abci.VoteInfo
+        votes = [
+            _vi(
+                val.address,
+                val.voting_power,
+                i < n_pc and precommits[i] is not None,
             )
+            for i, val in enumerate(last_val_set.validators)
+        ]
     byz = []
     for ev in block.evidence.evidence:
         try:
@@ -264,9 +265,16 @@ def _get_begin_block_validator_info(
 def update_validators(current_set: ValidatorSet, updates: List[abci.ValidatorUpdate]) -> None:
     """Apply EndBlock deltas: power 0 removes, unknown adds, known updates
     (execution.go:318)."""
+    from tendermint_tpu.types.validator_set import _MAX_TOTAL_POWER
+
     for vu in updates:
         if vu.power < 0:
             raise ValueError(f"voting power can't be negative: {vu}")
+        if vu.power > _MAX_TOTAL_POWER:
+            # the set's arithmetic clips at this bound and its codec packs
+            # powers as int64 — an app granting more must be rejected here,
+            # not crash the node at the next save_state
+            raise ValueError(f"voting power {vu.power} exceeds maximum")
         if vu.pub_key_type == "ed25519":
             pub = PubKeyEd25519(vu.pub_key)
         elif vu.pub_key_type == "secp256k1":

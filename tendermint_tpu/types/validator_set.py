@@ -72,20 +72,6 @@ class Validator:
         w.bytes(self.pub_key.bytes()).svarint(self.voting_power)
         return w.build()
 
-    def encode(self, w: Writer) -> None:
-        # binary (type name + raw key): this runs 3×/valset on every
-        # save_state — the JSON/base64 form it replaced was the single
-        # hottest line of fast-sync block application
-        w.string(self.pub_key.type_name)
-        w.bytes(self.pub_key.bytes())
-        w.svarint(self.voting_power).svarint(self.accum)
-
-    @classmethod
-    def decode(cls, r: Reader) -> "Validator":
-        from tendermint_tpu.crypto.keys import _PUBKEY_TYPES
-
-        pk = _PUBKEY_TYPES[r.string()](r.bytes())
-        return cls(pub_key=pk, voting_power=r.svarint(), accum=r.svarint())
 
 
 class ValidatorSet:
@@ -101,8 +87,20 @@ class ValidatorSet:
         self._hash: Optional[bytes] = None  # memoized; accum-independent
         self._mver = 0  # bumped on any accum/membership change
         self._marshal_cache: Optional[Tuple[int, bytes]] = None
+        self._members_blob: Optional[bytes] = None  # encode()'s pubkey section
+        self._cow = False  # True => `validators` is shared with another set
         if vals:
             self.increment_accum(1)
+
+    def _materialize(self) -> None:
+        """Ensure `validators` is privately owned before any in-place
+        mutation.  copy() shares the list copy-on-write: update_state makes
+        three whole-set copies per applied block and at most one of them is
+        ever mutated (accum advance), so eager deep copies were the single
+        largest slice of the fast-sync host ms/block."""
+        if self._cow:
+            self.validators = [v.copy() for v in self.validators]
+            self._cow = False
 
     def _addr_list(self) -> List[bytes]:
         if self._addresses is None:
@@ -116,6 +114,7 @@ class ValidatorSet:
         self._total_voting_power = None
         self._addresses = None
         self._hash = None
+        self._members_blob = None
         self._mver += 1
 
     # size / lookup --------------------------------------------------------
@@ -163,9 +162,15 @@ class ValidatorSet:
         return self.proposer.copy()
 
     def _find_proposer(self) -> Validator:
+        # compare_accum inlined: this runs per applied block (and `times`
+        # rounds deep in increment_accum) — higher accum wins, ties break
+        # toward the lower address
         best = self.validators[0]
+        ba, baddr = best.accum, best.address
         for v in self.validators[1:]:
-            best = best.compare_accum(v)
+            a = v.accum
+            if a > ba or (a == ba and v.address < baddr):
+                best, ba, baddr = v, a, v.address
         return best
 
     def increment_accum(self, times: int) -> None:
@@ -173,22 +178,40 @@ class ValidatorSet:
         becomes proposer, minus totalPower (ref validator_set.go:65-88)."""
         if not self.validators:
             raise ValueError("empty validator set")
+        self._materialize()
         self._mver += 1  # accums change -> cached marshal bytes stale
+        # _clip inlined (bounds semantics of the reference's int64-overflow
+        # clips): two clipped adds per validator per block made this the
+        # hottest line of fast-sync apply
+        hi, lo = _MAX_TOTAL_POWER, -_MAX_TOTAL_POWER
         for v in self.validators:
-            v.accum = _clip(v.accum + _clip(v.voting_power * times))
+            d = v.voting_power * times
+            if d > hi:
+                d = hi
+            elif d < lo:
+                d = lo
+            a = v.accum + d
+            v.accum = hi if a > hi else (lo if a < lo else a)
+        total = self.total_voting_power()
         for i in range(times):
             mostest = self._find_proposer()
-            mostest.accum = _clip(mostest.accum - self.total_voting_power())
+            a = mostest.accum - total
+            mostest.accum = hi if a > hi else (lo if a < lo else a)
             if i == times - 1:
                 self.proposer = mostest
 
     def copy(self) -> "ValidatorSet":
+        # O(1): the validator list is SHARED until either side mutates
+        # (_materialize above) — callers see deep-copy semantics throughout
         new = ValidatorSet.__new__(ValidatorSet)
-        new.validators = [v.copy() for v in self.validators]
+        new.validators = self.validators
+        new._cow = True
+        self._cow = True
         new.proposer = self.proposer
         new._total_voting_power = self._total_voting_power
-        new._addresses = None
+        new._addresses = self._addresses  # same membership (rebuilt-if-None)
         new._hash = self._hash  # membership identical; accum changes don't matter
+        new._members_blob = self._members_blob
         new._mver = 0
         new._marshal_cache = (
             (0, self._marshal_cache[1])
@@ -208,6 +231,7 @@ class ValidatorSet:
         (ref validator_set.go:189-212)."""
         if self.has_address(val.address):
             return False
+        self._materialize()
         self.validators.append(val.copy())
         self.validators.sort(key=lambda v: v.address)
         self._invalidate()
@@ -219,6 +243,7 @@ class ValidatorSet:
         idx, _ = self.get_by_address(val.address)
         if idx == -1:
             return False
+        self._materialize()
         self.validators[idx] = val.copy()
         self._invalidate()
         return True
@@ -227,6 +252,7 @@ class ValidatorSet:
         idx, _ = self.get_by_address(address)
         if idx == -1:
             return None
+        self._materialize()
         removed = self.validators.pop(idx)
         self._invalidate()
         return removed
@@ -264,8 +290,17 @@ class ValidatorSet:
         # template per distinct block_id and patch timestamps instead of
         # re-encoding ~110 bytes per precommit (the sign-bytes assembly was
         # a top host cost of fast sync; ref loop types/validator_set.go:281).
-        templates: dict = {}
+        # The overwhelmingly common case is every precommit voting block_id,
+        # so that template is prebuilt and picked by ONE equality test per
+        # precommit (a dict keyed by BlockID pays a multi-field hash each
+        # probe); the same test decides power attribution.
+        main_tpl = canonical_vote_sign_bytes(
+            chain_id, SignedMsgType.PRECOMMIT, height, round, 0, block_id
+        )
+        main_head, main_tail = main_tpl[:17], main_tpl[25:]
+        stray_templates: Optional[dict] = None
         _pack_ts = _struct.Struct("<q").pack
+        vals = self.validators
         pubkeys, msgs, sigs, powers = [], [], [], []
         for idx, precommit in enumerate(commit.precommits):
             if precommit is None:
@@ -276,22 +311,28 @@ class ValidatorSet:
                 raise CommitError(f"precommit round {precommit.round} != {round}")
             if precommit.vote_type != SignedMsgType.PRECOMMIT:
                 raise CommitError(f"not a precommit @ index {idx}")
-            val = self.validators[idx]
+            val = vals[idx]
             pubkeys.append(val.pub_key)
             key = precommit.block_id
-            tpl = templates.get(key)
-            if tpl is None:
-                tpl = canonical_vote_sign_bytes(
-                    chain_id, SignedMsgType.PRECOMMIT, height, round, 0, key
+            if key == block_id:
+                msgs.append(
+                    main_head + _pack_ts(precommit.timestamp_ns) + main_tail
                 )
-                templates[key] = tpl
-            msgs.append(
-                tpl[:17] + _pack_ts(precommit.timestamp_ns) + tpl[25:]
-            )
+                powers.append(val.voting_power)
+            else:  # stray vote: counts for availability, not power
+                if stray_templates is None:
+                    stray_templates = {}
+                tpl = stray_templates.get(key)
+                if tpl is None:
+                    tpl = canonical_vote_sign_bytes(
+                        chain_id, SignedMsgType.PRECOMMIT, height, round, 0, key
+                    )
+                    stray_templates[key] = tpl
+                msgs.append(
+                    tpl[:17] + _pack_ts(precommit.timestamp_ns) + tpl[25:]
+                )
+                powers.append(0)
             sigs.append(precommit.signature)
-            powers.append(
-                val.voting_power if block_id == precommit.block_id else 0
-            )
         return pubkeys, msgs, sigs, powers
 
     def verify_commit(
@@ -362,13 +403,31 @@ class ValidatorSet:
             )
 
     # codec ----------------------------------------------------------------
+    def _members_bytes(self) -> bytes:
+        """Pubkey section of the encoding (type names + raw keys), cached
+        until membership changes: accums advance every applied block, so
+        encode() runs per block, but the membership almost never changes —
+        only the two small power/accum arrays need fresh bytes."""
+        if self._members_blob is None:
+            w = Writer()
+            for v in self.validators:
+                w.string(v.pub_key.type_name)
+                w.bytes(v.pub_key.bytes())
+            self._members_blob = w.build()
+        return self._members_blob
+
+    _CODEC_VERSION = 2  # 1 = per-validator svarint records (retired)
+
     def encode(self, w: Writer) -> None:
-        w.uvarint(len(self.validators))
-        for v in self.validators:
-            v.encode(w)
+        vals = self.validators
+        w.uvarint(self._CODEC_VERSION)
+        w.uvarint(len(vals))
+        w.bytes(self._members_bytes())
+        w.bytes(_struct.pack(f"<{len(vals)}q", *(v.voting_power for v in vals)))
+        w.bytes(_struct.pack(f"<{len(vals)}q", *(v.accum for v in vals)))
         prop_idx = -1
         if self.proposer is not None:
-            for i, v in enumerate(self.validators):
+            for i, v in enumerate(vals):
                 if v.address == self.proposer.address:
                     prop_idx = i
                     break
@@ -387,8 +446,25 @@ class ValidatorSet:
 
     @classmethod
     def decode(cls, r: Reader) -> "ValidatorSet":
+        from tendermint_tpu.crypto.keys import _PUBKEY_TYPES
+
+        ver = r.uvarint()
+        if ver != cls._CODEC_VERSION:
+            raise ValueError(
+                f"validator-set codec version {ver} unsupported "
+                f"(this build reads {cls._CODEC_VERSION}); "
+                "regenerate the state dir"
+            )
         n = r.uvarint()
-        vals = [Validator.decode(r) for _ in range(n)]
+        members_blob = r.bytes()
+        mr = Reader(members_blob)
+        pks = [_PUBKEY_TYPES[mr.string()](mr.bytes()) for _ in range(n)]
+        powers = _struct.unpack(f"<{n}q", r.bytes())
+        accums = _struct.unpack(f"<{n}q", r.bytes())
+        vals = [
+            Validator(pub_key=pk, voting_power=p, accum=a)
+            for pk, p, a in zip(pks, powers, accums)
+        ]
         prop_idx = r.svarint()
         vs = cls.__new__(cls)
         vs.validators = vals
@@ -397,6 +473,8 @@ class ValidatorSet:
         vs._hash = None
         vs._mver = 0
         vs._marshal_cache = None
+        vs._members_blob = members_blob
+        vs._cow = False
         vs.proposer = vals[prop_idx] if 0 <= prop_idx < len(vals) else None
         return vs
 

@@ -16,44 +16,22 @@ if "xla_force_host_platform_device_count" not in _flags:
     ).strip()
 os.environ["JAX_PLATFORMS"] = "cpu"
 
-import subprocess
-
 import jax
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 # Keep CPU as the default backend (8 virtual devices for sharding tests) but
 # also expose the real TPU chip when its tunnel is reachable — the Pallas
 # kernel tests dispatch to it explicitly (interpret mode is far too slow).
 #
 # The tunnel can HANG (not error) during backend discovery when the remote
-# side is down, so the probe must run in a subprocess with a hard timeout —
-# an in-process try/except would block the whole test session. The verdict
-# is cached in the environment: localnet tests spawn child processes that
-# import this conftest and must not pay (or re-hang on) the probe.
+# side is down, so liveness comes from libs/tpu_probe's subprocess probe
+# (hard timeout, verdict cached in TM_AXON_ALIVE: localnet tests spawn child
+# processes that import this conftest and must not pay — or re-hang on —
+# the probe).  Production verifier selection uses the same probe.
+from tendermint_tpu.libs.tpu_probe import tpu_alive  # noqa: E402
 
-
-def _axon_alive() -> bool:
-    cached = os.environ.get("TM_AXON_ALIVE")
-    if cached is not None:
-        return cached == "1"
-    try:
-        res = subprocess.run(
-            [
-                sys.executable,
-                "-c",
-                "import os; os.environ['JAX_PLATFORMS']='axon';"
-                "import jax; jax.devices()",
-            ],
-            timeout=45,
-            capture_output=True,
-        )
-        alive = res.returncode == 0
-    except Exception:
-        alive = False
-    os.environ["TM_AXON_ALIVE"] = "1" if alive else "0"
-    return alive
-
-
-if _axon_alive():
+if tpu_alive():
     try:
         jax.config.update("jax_platforms", "cpu,axon")
         jax.devices()
@@ -62,8 +40,6 @@ if _axon_alive():
         jax.config.update("jax_platforms", "cpu")
 else:
     jax.config.update("jax_platforms", "cpu")
-
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 # Consensus/state tests verify tiny commits in their hot loops; the process-wide
 # default verifier must NOT auto-select the tunnel-attached TPU (per-dispatch

@@ -96,13 +96,24 @@ class TestHTTPClient:
     def test_unsafe_flush_mempool(self, client):
         client.unsafe_flush_mempool()
 
-    def test_unsafe_heap_profile_route(self, client, tmp_path):
-        out = str(tmp_path / "heap.txt")
-        res = client.call("unsafe_write_heap_profile", filename=out)
-        assert res["filename"] == out
+    def test_unsafe_heap_profile_route(self, client):
         import os
+        import tempfile
 
-        assert os.path.exists(out)
+        res = client.call("unsafe_write_heap_profile", filename="heap-route.txt")
+        # bare names resolve under a node-owned 0700 profile dir; path
+        # traversal is rejected (an unsafe RPC route must not be a
+        # file-overwrite primitive, nor follow planted /tmp symlinks)
+        assert res["filename"] == os.path.join(
+            tempfile.gettempdir(),
+            f"tm-tpu-profiles-{os.getuid()}",
+            "heap-route.txt",
+        )
+        assert os.path.exists(res["filename"])
+        with pytest.raises(RPCClientError):
+            client.call(
+                "unsafe_write_heap_profile", filename="../../etc/overwrite"
+            )
         # tracing is stoppable without a restart (it taxes every allocation)
         stop = client.call("unsafe_stop_heap_profiler")
         assert stop["was_tracing"] is True
