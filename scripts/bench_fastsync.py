@@ -122,6 +122,10 @@ def main():
     else:
         try:
             verifier = TPUBatchVerifier()
+            if verifier.backend != "pallas":
+                # dead tunnel: XLA-on-CPU is ~100x slower than the host C
+                # path — fall back to host like the production default does
+                verifier = HostBatchVerifier()
         except Exception:
             verifier = HostBatchVerifier()
 

@@ -46,8 +46,9 @@ SECTIONS = [
     (
         "Window sweep 64 validators (free verifier)",
         "window-size ladder justifying VERIFY_WINDOW "
-        "(blockchain/reactor.py:51)",
-        [PY, "scripts/bench_fastsync.py", "512", "64", "--sweep",
+        "(blockchain/reactor.py:51); host-pipeline view — on the chip the "
+        "window additionally amortizes dispatch latency",
+        [PY, "scripts/bench_fastsync.py", "768", "64", "--sweep",
          "--null-verify"],
         600,
     ),
@@ -75,9 +76,11 @@ if not FAST:
 SECTIONS += [
     (
         "secp256k1 batch verify",
-        "windowed-Straus kernel vs host (scripts/bench_secp.py)",
-        [PY, "scripts/bench_secp.py"],
-        600,
+        "windowed-Straus kernel vs host (scripts/bench_secp.py; 256 sigs — "
+        "the 1024-sig XLA-on-CPU compile alone exceeds any sane timeout "
+        "when the chip is down)",
+        [PY, "scripts/bench_secp.py", "256"],
+        900,
     ),
     (
         "multisig batch verify",
@@ -85,6 +88,14 @@ SECTIONS += [
         "(scripts/bench_multisig.py)",
         [PY, "scripts/bench_multisig.py"],
         600,
+    ),
+    (
+        "Pallas per-stage device profile (needs the chip)",
+        "prologue vs ladder vs host packing, plus reduced-window ladder "
+        "runs separating fixed cost from per-window slope; op-count model "
+        "in PERF.md (scripts/profile_pallas.py)",
+        [PY, "scripts/profile_pallas.py"],
+        900,
     ),
     (
         "Headline commit verify (bench.py)",
@@ -105,12 +116,17 @@ def _run(cmd, timeout):
         lines = [
             ln for ln in res.stdout.splitlines() if ln.strip().startswith("{")
         ]
-        rows = []
+        by_metric = {}
         for ln in lines:
             try:
-                rows.append(json.loads(ln))
+                row = json.loads(ln)
             except ValueError:
-                pass
+                continue
+            # benches may reprint a metric line augmented with extra fields
+            # (bench.py's headline contract) — keep only the last, most
+            # complete row per metric
+            by_metric[row.get("metric", ln)] = row
+        rows = list(by_metric.values())
         status = "ok" if res.returncode == 0 and rows else f"rc={res.returncode}"
     except subprocess.TimeoutExpired:
         rows, status = [], f"timeout>{timeout}s"
@@ -125,7 +141,14 @@ def main():
         ["git", "rev-parse", "--short", "HEAD"], cwd=_REPO,
         capture_output=True, text=True,
     ).stdout.strip()
-    tunnel = os.environ.get("TM_AXON_ALIVE", "unprobed")
+    # one probe for the whole ledger: the verdict propagates to every child
+    # via TM_AXON_ALIVE (otherwise each TPU-touching section re-pays the
+    # 45 s dead-tunnel probe) and is recorded in the header
+    sys.path.insert(0, _REPO)
+    from tendermint_tpu.libs.tpu_probe import tpu_alive
+
+    tunnel = "1" if tpu_alive() else "0"
+    print(f"== tunnel alive: {tunnel}", file=sys.stderr, flush=True)
     parts = [
         "# BENCH_LOCAL — committed perf ledger",
         "",

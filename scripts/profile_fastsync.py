@@ -24,22 +24,6 @@ N_VALS = int(sys.argv[2]) if len(sys.argv) > 2 else 64
 WINDOW = int(sys.argv[3]) if len(sys.argv) > 3 else 512
 
 
-class FreeVerifier:
-    """All-true: verification cost = 0, so the profile is pure host overhead."""
-
-    name = "free"
-
-    def verify_ed25519(self, items):
-        import numpy as np
-
-        return np.ones((len(items),), dtype=bool)
-
-    def verify_secp256k1(self, items):
-        import numpy as np
-
-        return np.ones((len(items),), dtype=bool)
-
-
 def main():
     from tendermint_tpu.crypto import batch as _batch
     from tendermint_tpu.crypto.batch import HostBatchVerifier
@@ -54,9 +38,9 @@ def main():
     print(f"# chain built in {time.perf_counter() - t0:.1f}s", file=sys.stderr)
     blocks = [fx.block_store.load_block(h) for h in range(1, N_BLOCKS + 1)]
 
-    from scripts.bench_fastsync import _fresh_executor
+    from scripts.bench_fastsync import NullVerifier, _fresh_executor
 
-    verifier = FreeVerifier()
+    verifier = NullVerifier()
 
     def run_pipeline():
         st, block_exec = _fresh_executor(fx.genesis)

@@ -1,96 +1,159 @@
-"""Per-stage timing of the Pallas ed25519 verify path on the real chip."""
+"""Per-stage timing of the Pallas ed25519 verify path on the real chip.
+
+Emits JSON lines (captured into BENCH_LOCAL.md by scripts/bench_ledger.py):
+  pallas_e2e_10k       — full verify_batch wall (host packing + dispatch)
+  pallas_prologue_10k  — SHA-512 + mod-L + digit extraction kernel
+  pallas_ladder_10k    — full 64-window Straus ladder kernel
+  pallas_ladder_w{n}   — reduced-window ladder runs; with the full run these
+                         separate the per-window slope from the fixed cost
+                         (per-signature table build + fe_inv + canonical
+                         compare), attributing the ladder milliseconds
+  pallas_host_packing  — host-side packing with a warm decompression cache
+
+Exits 0 with a note (and no JSON) when the TPU tunnel is down — the probe
+runs in a subprocess so a dead tunnel cannot hang this script
+(libs/tpu_probe).  PERF.md holds the matching op-count model.
+"""
+import json
 import os
 import sys
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-import jax
 import numpy as np
 
-from tendermint_tpu.crypto import ed25519 as ed
-from tendermint_tpu.ops import ed25519_pallas as pk
+from tendermint_tpu.libs.tpu_probe import tpu_alive
 
 N = 10_000
 MSG_LEN = 110
 
-rng = np.random.default_rng(42)
-seeds = rng.bytes(32 * N)
-pubs = np.zeros((N, 32), np.uint8)
-sigs = np.zeros((N, 64), np.uint8)
-msgs = []
-for i in range(N):
-    priv = ed.gen_privkey(seeds[32 * i : 32 * (i + 1)])
-    msg = bytes([i & 0xFF, (i >> 8) & 0xFF]) * (MSG_LEN // 2)
-    pubs[i] = np.frombuffer(priv[32:], np.uint8)
-    sigs[i] = np.frombuffer(ed.sign(priv, msg), np.uint8)
-    msgs.append(msg)
 
-print("devices:", jax.devices())
+def _emit(metric, ms):
+    print(json.dumps({"metric": metric, "value": round(ms, 3), "unit": "ms"}),
+          flush=True)
 
-# end-to-end
-ok = pk.verify_batch(pubs, msgs, sigs)
-assert ok.all()
-ts = []
-for _ in range(5):
-    t0 = time.perf_counter()
-    pk.verify_batch(pubs, msgs, sigs)
-    ts.append(time.perf_counter() - t0)
-print(f"end-to-end verify_batch: {np.median(ts)*1e3:.1f} ms")
 
-# stage split: host packing vs prologue vs ladder
-neg_ax, ay, valid = pk._decompress_valset(pubs)
-n = N
-b = pk._bucket(n)
-total = 64 + MSG_LEN
-nblocks = (total + 1 + 16 + 127) // 128
-padded = np.zeros((b, nblocks * 128), dtype=np.uint8)
-padded[:n, :32] = sigs[:, :32]
-padded[:n, 32:64] = pubs
-m = np.frombuffer(b"".join(msgs), dtype=np.uint8).reshape(n, MSG_LEN)
-padded[:n, 64:total] = m
-padded[:, total] = 0x80
-padded[:, -16:] = np.frombuffer((total * 8).to_bytes(16, "big"), np.uint8)
-msg_words = padded.reshape(b, -1, 4)[:, :, ::-1].reshape(b, -1)
-msg_words = np.ascontiguousarray(msg_words).view("<u4").astype(np.uint32)
-sig_words = np.ascontiguousarray(sigs).view("<u4").astype(np.uint32)
-
-import jax.numpy as jnp
-
-negax_d = jnp.asarray(pk._pad_rows(neg_ax, b)).T
-ay_d = jnp.asarray(pk._pad_rows(ay, b)).T
-sigw_d = jnp.asarray(pk._pad_rows(sig_words, b)).T
-msgw_d = jnp.asarray(msg_words).T
-
-prologue = jax.jit(lambda mw, sw: pk._prologue_call(mw, sw))
-ladder = jax.jit(
-    lambda nx, ayy, digs, digh, rl, rs: pk._ladder_call(nx, ayy, digs, digh, rl, rs)
-)
-
-digs, digh, rlimb, rsign = jax.block_until_ready(prologue(msgw_d, sigw_d))
-out = jax.block_until_ready(ladder(negax_d, ay_d, digs, digh, rlimb, rsign))
-
-for name, fn, args in [
-    ("prologue", prologue, (msgw_d, sigw_d)),
-    ("ladder", ladder, (negax_d, ay_d, digs, digh, rlimb, rsign)),
-]:
+def _median_ms(fn, reps=5):
     ts = []
-    for _ in range(5):
+    for _ in range(reps):
         t0 = time.perf_counter()
-        jax.block_until_ready(fn(*args))
+        fn()
         ts.append(time.perf_counter() - t0)
-    print(f"{name}: {np.median(ts)*1e3:.1f} ms")
+    return float(np.median(ts)) * 1e3
 
-# host-side packing cost
-ts = []
-for _ in range(5):
-    t0 = time.perf_counter()
-    pk._decompress_valset(pubs)
-    padded2 = np.zeros((b, nblocks * 128), dtype=np.uint8)
-    padded2[:n, :32] = sigs[:, :32]
-    padded2[:n, 32:64] = pubs
-    padded2[:n, 64:total] = m
-    mw = padded2.reshape(b, -1, 4)[:, :, ::-1].reshape(b, -1)
-    mw = np.ascontiguousarray(mw).view("<u4").astype(np.uint32)
-    ts.append(time.perf_counter() - t0)
-print(f"host packing (cached decompress): {np.median(ts)*1e3:.1f} ms")
+
+def main():
+    if not tpu_alive():
+        print("# TPU tunnel is down — no device profile this run",
+              file=sys.stderr)
+        return 0
+
+    import jax
+    import jax.numpy as jnp
+
+    from tendermint_tpu.crypto import ed25519 as ed
+    from tendermint_tpu.ops import ed25519_pallas as pk
+
+    rng = np.random.default_rng(42)
+    seeds = rng.bytes(32 * N)
+    pubs = np.zeros((N, 32), np.uint8)
+    sigs = np.zeros((N, 64), np.uint8)
+    msgs = []
+    for i in range(N):
+        priv = ed.gen_privkey(seeds[32 * i : 32 * (i + 1)])
+        msg = bytes([i & 0xFF, (i >> 8) & 0xFF]) * (MSG_LEN // 2)
+        pubs[i] = np.frombuffer(priv[32:], np.uint8)
+        sigs[i] = np.frombuffer(ed.sign(priv, msg), np.uint8)
+        msgs.append(msg)
+
+    print("# devices:", jax.devices(), file=sys.stderr)
+
+    ok = pk.verify_batch(pubs, msgs, sigs)  # warm (compile + upload)
+    assert ok.all()
+    _emit("pallas_e2e_10k", _median_ms(lambda: pk.verify_batch(pubs, msgs, sigs)))
+
+    # stage split: host packing vs prologue vs ladder
+    neg_ax, ay, _valid = pk._decompress_valset(pubs)
+    n = N
+    b = pk._bucket(n)
+    total = 64 + MSG_LEN
+    nblocks = (total + 1 + 16 + 127) // 128
+    padded = np.zeros((b, nblocks * 128), dtype=np.uint8)
+    padded[:n, :32] = sigs[:, :32]
+    padded[:n, 32:64] = pubs
+    m = np.frombuffer(b"".join(msgs), dtype=np.uint8).reshape(n, MSG_LEN)
+    padded[:n, 64:total] = m
+    padded[:, total] = 0x80
+    padded[:, -16:] = np.frombuffer((total * 8).to_bytes(16, "big"), np.uint8)
+    msg_words = padded.reshape(b, -1, 4)[:, :, ::-1].reshape(b, -1)
+    msg_words = np.ascontiguousarray(msg_words).view("<u4").astype(np.uint32)
+    sig_words = np.ascontiguousarray(sigs).view("<u4").astype(np.uint32)
+
+    negax_d = jnp.asarray(pk._pad_rows(neg_ax, b)).T
+    ay_d = jnp.asarray(pk._pad_rows(ay, b)).T
+    sigw_d = jnp.asarray(pk._pad_rows(sig_words, b)).T
+    msgw_d = jnp.asarray(msg_words).T
+
+    prologue = jax.jit(lambda mw, sw: pk._prologue_call(mw, sw))
+    ladder = jax.jit(
+        lambda nx, ayy, digs, digh, rl, rs: pk._ladder_call(
+            nx, ayy, digs, digh, rl, rs
+        )
+    )
+
+    digs, digh, rlimb, rsign = jax.block_until_ready(prologue(msgw_d, sigw_d))
+    jax.block_until_ready(ladder(negax_d, ay_d, digs, digh, rlimb, rsign))
+
+    _emit(
+        "pallas_prologue_10k",
+        _median_ms(lambda: jax.block_until_ready(prologue(msgw_d, sigw_d))),
+    )
+    _emit(
+        "pallas_ladder_10k",
+        _median_ms(
+            lambda: jax.block_until_ready(
+                ladder(negax_d, ay_d, digs, digh, rlimb, rsign)
+            )
+        ),
+    )
+
+    # fixed-vs-slope attribution: the ladder kernel takes its window count
+    # from the digit rows, so short digit arrays time the same kernel with
+    # fewer windows.  cost(nwin) ≈ fixed (table build + fe_inv + canonical
+    # compare) + slope·nwin; see PERF.md for the matching op counts.
+    for nwin in (1, 16):
+        digs_n = digs[:nwin]
+        digh_n = digh[:nwin]
+        lad_n = jax.jit(
+            lambda nx, ayy, dg, dh, rl, rs: pk._ladder_call(
+                nx, ayy, dg, dh, rl, rs
+            )
+        )
+        jax.block_until_ready(
+            lad_n(negax_d, ay_d, digs_n, digh_n, rlimb, rsign)
+        )
+        _emit(
+            f"pallas_ladder_w{nwin}",
+            _median_ms(
+                lambda: jax.block_until_ready(
+                    lad_n(negax_d, ay_d, digs_n, digh_n, rlimb, rsign)
+                )
+            ),
+        )
+
+    def _pack():
+        pk._decompress_valset(pubs)
+        padded2 = np.zeros((b, nblocks * 128), dtype=np.uint8)
+        padded2[:n, :32] = sigs[:, :32]
+        padded2[:n, 32:64] = pubs
+        padded2[:n, 64:total] = m
+        mw = padded2.reshape(b, -1, 4)[:, :, ::-1].reshape(b, -1)
+        np.ascontiguousarray(mw).view("<u4").astype(np.uint32)
+
+    _emit("pallas_host_packing", _median_ms(_pack))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -43,11 +43,14 @@ MAX_MSG_SIZE = 104857600  # 100 MB protocol block ceiling (types/params.go:11)
 TRY_SYNC_INTERVAL = 0.01  # reference trySyncTicker 10ms
 STATUS_UPDATE_INTERVAL = 2.0  # reference 10s; shrunk for test nets
 SWITCH_TO_CONSENSUS_INTERVAL = 0.5  # reference 1s
-# Heights verified per device dispatch. The bench sweep (scripts/
-# bench_fastsync.py --sweep) puts the throughput knee at ~512 heights for
-# small valsets — below that, fixed dispatch latency dominates; above, the
-# batch no longer amortizes. auto_verify_window shrinks it for huge valsets
-# so a window's signature tensor stays within device memory.
+# Heights verified per device dispatch. Two regimes (sweep tables in
+# BENCH_LOCAL.md, scripts/bench_fastsync.py --sweep): the HOST pipeline
+# alone is window-size-insensitive up to ~128 and degrades slightly beyond
+# (cache pressure in the packing loop), while the DEVICE dispatch wants the
+# largest window that fits — one tunnel round-trip and one kernel launch
+# amortized over window×valset signatures. 512 favors the device regime
+# this framework exists for; auto_verify_window shrinks it for huge
+# valsets so a window's signature tensor stays within device memory.
 VERIFY_WINDOW = 512
 MAX_WINDOW_SIGS = 512 * 1024  # |window| × |valset| ceiling per dispatch
 

@@ -178,7 +178,13 @@ def get_batch_verifier(prefer_tpu: bool = True):
                 _default = TPUBatchVerifier(backend=forced)
             elif prefer_tpu:
                 try:
-                    _default = TPUBatchVerifier()
+                    v = TPUBatchVerifier()
+                    # dead/absent chip degrades the verifier to XLA — but on
+                    # a CPU-only host the XLA kernel is ~100x slower than the
+                    # host C path, so the lazy default only keeps the device
+                    # verifier when the fused pipeline is actually reachable
+                    # (TM_BATCH_VERIFIER=xla forces the XLA backend instead)
+                    _default = v if v.backend == "pallas" else HostBatchVerifier()
                 except Exception:
                     _default = HostBatchVerifier()
             else:

@@ -270,16 +270,22 @@ def _canonical_ref(v, s1, s2):
     return jnp.where(ge, s2[:], s1[:])
 
 
-def _ladder_kernel(consts_ref, negax_ref, ay_ref, digs_ref, digh_ref,
-                   rlimb_ref, rsign_ref, out_ref, s1, s2):
-    B = negax_ref.shape[1]
+def ladder_math(consts, negax, ay, digs_get, digh_get, nwin: int = NWIN,
+                loop=lax.fori_loop):
+    """The windowed-Straus double-scalar multiply [s]B + [h](-A) — pure jnp,
+    shared by the pallas kernel (on ref values) and the CPU parity tests
+    (tests/test_pallas_interpret.py).  digs_get/digh_get: t -> (1, B)
+    digit row accessors (a ref slice in-kernel, an array row in tests).
+    nwin < NWIN drives the identical code with small scalars; tests also
+    swap `loop` for a plain Python loop so the whole thing evaluates
+    eagerly (XLA's CPU compile of these graphs runs minutes — its
+    simplifier thrashes on the carry patterns).  Returns (X, Y, Z, T)."""
+    B = negax.shape[1]
     zero = jnp.zeros((NLIMB, B), jnp.uint32)
     one = jnp.pad(jnp.ones((1, B), jnp.uint32), ((0, NLIMB - 1), (0, 0)))
-    d2 = consts_ref[:, 48:49]
-    ksub = consts_ref[:, 49:50]
+    d2 = consts[:, 48:49]
+    ksub = consts[:, 49:50]
 
-    negax = negax_ref[:]
-    ay = ay_ref[:]
     ident = (zero, one, one, zero)
     a1 = (negax, ay, one, fe_mul(negax, ay))
 
@@ -303,21 +309,34 @@ def _ladder_kernel(consts_ref, negax_ref, ay_ref, digs_ref, digh_ref,
     def body(t, acc):
         for _ in range(4):
             acc = pt_double(acc, ksub)
-        ds = digs_ref[pl.ds(t, 1), :]  # (1, B)
-        dh = digh_ref[pl.ds(t, 1), :]
+        ds = digs_get(t)  # (1, B)
+        dh = digh_get(t)
         mk_s = [(ds == j).astype(jnp.uint32) for j in range(16)]
         mk_h = [(dh == j).astype(jnp.uint32) for j in range(16)]
         # constant niels entry for the B part: (20, 1) x (1, B) masked sum
-        ypx = sum(consts_ref[:, j : j + 1] * mk_s[j] for j in range(16))
-        ymx = sum(consts_ref[:, 16 + j : 17 + j] * mk_s[j] for j in range(16))
-        t2d = sum(consts_ref[:, 32 + j : 33 + j] * mk_s[j] for j in range(16))
+        ypx = sum(consts[:, j : j + 1] * mk_s[j] for j in range(16))
+        ymx = sum(consts[:, 16 + j : 17 + j] * mk_s[j] for j in range(16))
+        t2d = sum(consts[:, 32 + j : 33 + j] * mk_s[j] for j in range(16))
         acc = pt_madd(acc, ypx, ymx, t2d, ksub)
         q = (select16(tbl_x, mk_h), select16(tbl_y, mk_h),
              select16(tbl_z, mk_h), select16(tbl_t, mk_h))
         acc = pt_add(acc, q, d2, ksub)
         return acc
 
-    X, Y, Z, _T = lax.fori_loop(0, NWIN, body, ident)
+    return loop(0, nwin, body, ident)
+
+
+def _ladder_kernel(consts_ref, negax_ref, ay_ref, digs_ref, digh_ref,
+                   rlimb_ref, rsign_ref, out_ref, s1, s2):
+    # window count comes from the digit rows: production always passes
+    # (NWIN, B), while reduced parity tests drive the identical math with
+    # fewer windows (small scalars)
+    X, Y, Z, _T = ladder_math(
+        consts_ref[:], negax_ref[:], ay_ref[:],
+        lambda t: digs_ref[pl.ds(t, 1), :],
+        lambda t: digh_ref[pl.ds(t, 1), :],
+        nwin=digs_ref.shape[0],
+    )
 
     zinv = fe_inv(Z)
     x = _canonical_ref(fe_mul(X, zinv), s1, s2)
@@ -329,11 +348,13 @@ def _ladder_kernel(consts_ref, negax_ref, ay_ref, digs_ref, digh_ref,
 
 def _ladder_call(negax, ay, digs, digh, rlimb, rsign, *, interpret=False,
                  lanes=LANES):
-    """negax/ay/rlimb (20, N), digs/digh (64, N), rsign (1, N); N % lanes == 0."""
+    """negax/ay/rlimb (20, N), digs/digh (nwin, N) — NWIN=64 in production,
+    fewer in the reduced interpret tests — rsign (1, N); N % lanes == 0."""
     n = negax.shape[1]
+    nwin = digs.shape[0]
     cspec = pl.BlockSpec((NLIMB, 52), lambda i: (0, 0), memory_space=pltpu.VMEM)
     spec20 = pl.BlockSpec((NLIMB, lanes), lambda i: (0, i), memory_space=pltpu.VMEM)
-    spec64 = pl.BlockSpec((NWIN, lanes), lambda i: (0, i), memory_space=pltpu.VMEM)
+    spec64 = pl.BlockSpec((nwin, lanes), lambda i: (0, i), memory_space=pltpu.VMEM)
     spec1 = pl.BlockSpec((1, lanes), lambda i: (0, i), memory_space=pltpu.VMEM)
     return pl.pallas_call(
         _ladder_kernel,

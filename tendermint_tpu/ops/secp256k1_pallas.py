@@ -226,11 +226,15 @@ def _canonical_ref(v, s1, s2):
 # ---------------------------------------------------------------------------
 
 
-def ladder_math(consts, qx, qy, dig1_get, dig2_get):
+def ladder_math(consts, qx, qy, dig1_get, dig2_get, nwin: int = NWIN,
+                loop=lax.fori_loop):
     """The windowed-Straus double-scalar multiply u1·G + u2·Q — pure jnp,
-    shared by the pallas kernel (on ref values) and the CPU-jittable parity
-    test. dig1_get/dig2_get: t -> (1, B) digit row accessors (a ref slice
-    in-kernel, an array row in tests). Returns projective (X, Y, Z)."""
+    shared by the pallas kernel (on ref values) and the CPU parity tests.
+    dig1_get/dig2_get: t -> (1, B) digit row accessors (a ref slice
+    in-kernel, an array row in tests). nwin < NWIN drives the identical
+    code with small scalars, and tests swap `loop` for a plain Python loop
+    to evaluate eagerly (XLA's CPU compile of this graph thrashes for
+    ~10 min in the simplifier). Returns projective (X, Y, Z)."""
     B = qx.shape[1]
     zero = jnp.zeros((NLIMB, B), jnp.uint32)
     one = jnp.pad(jnp.ones((1, B), jnp.uint32), ((0, NLIMB - 1), (0, 0)))
@@ -270,7 +274,7 @@ def ladder_math(consts, qx, qy, dig1_get, dig2_get):
         acc = pt_add(acc, q_sel, ksub)
         return acc
 
-    return lax.fori_loop(0, NWIN, body, ident)
+    return loop(0, nwin, body, ident)
 
 
 def _ladder_kernel(consts_ref, qx_ref, qy_ref, dig1_ref, dig2_ref,
@@ -281,6 +285,7 @@ def _ladder_kernel(consts_ref, qx_ref, qy_ref, dig1_ref, dig2_ref,
         consts, qx_ref[:], qy_ref[:],
         lambda t: dig1_ref[pl.ds(t, 1), :],
         lambda t: dig2_ref[pl.ds(t, 1), :],
+        nwin=dig1_ref.shape[0],
     )
 
     z_can = _canonical_ref(Z, s1, s2)
@@ -295,11 +300,13 @@ def _ladder_kernel(consts_ref, qx_ref, qy_ref, dig1_ref, dig2_ref,
 
 def _ladder_call(qx, qy, dig1, dig2, rl, rnl, rnok, *, interpret=False,
                  lanes=LANES):
-    """qx/qy/rl/rnl (20, N); dig1/dig2 (64, N); rnok (1, N); N % lanes == 0."""
+    """qx/qy/rl/rnl (20, N); dig1/dig2 (nwin, N) — NWIN=64 in production,
+    fewer in the reduced interpret tests; rnok (1, N); N % lanes == 0."""
     n = qx.shape[1]
+    nwin = dig1.shape[0]
     cspec = pl.BlockSpec((NLIMB, 49), lambda i: (0, 0), memory_space=pltpu.VMEM)
     spec20 = pl.BlockSpec((NLIMB, lanes), lambda i: (0, i), memory_space=pltpu.VMEM)
-    spec64 = pl.BlockSpec((NWIN, lanes), lambda i: (0, i), memory_space=pltpu.VMEM)
+    spec64 = pl.BlockSpec((nwin, lanes), lambda i: (0, i), memory_space=pltpu.VMEM)
     spec1 = pl.BlockSpec((1, lanes), lambda i: (0, i), memory_space=pltpu.VMEM)
     return pl.pallas_call(
         _ladder_kernel,

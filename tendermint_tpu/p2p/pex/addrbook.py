@@ -218,6 +218,7 @@ class AddrBook:
                     addr=k.addr, src=k.src, attempts=k.attempts,
                     last_attempt=k.last_attempt, last_success=k.last_success,
                     bucket_type=k.bucket_type,
+                    last_attempt_mono=k.last_attempt_mono,
                 )
                 for k in self._by_id.values()
             ]

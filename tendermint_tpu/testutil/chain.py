@@ -63,7 +63,9 @@ def build_chain(
     on_height(h, state) -> txs lets callers inject txs (e.g. valset changes
     via PersistentKVStoreApp val-txs)."""
     if genesis is None:
-        seeds = [bytes([i + 1]) * 32 for i in range(n_vals)]
+        # 4-byte counter repeated: unique for any n_vals (a single repeated
+        # byte capped fixtures at 255 validators)
+        seeds = [(i + 1).to_bytes(4, "big") * 8 for i in range(n_vals)]
         pv_list = [MockPV(PrivKeyEd25519.generate(s)) for s in seeds]
         genesis = GenesisDoc(
             chain_id=chain_id,

@@ -69,6 +69,19 @@ class TestAddrBook:
             book.mark_attempt(a)
         assert not book.has_address(a)
 
+    def test_list_known_carries_monotonic_attempt_stamp(self):
+        """The crawl throttle reads last_attempt_mono off list_known()
+        snapshots — a copy that drops it (always 0.0) disables the
+        crawl-interval throttle entirely and hopeless-drops fresh
+        addresses within a few crawl passes."""
+        book = AddrBook(None)
+        a = _addr(5)
+        book.add_address(a, a)
+        book.mark_attempt(a)
+        (ka,) = book.list_known()
+        assert ka.last_attempt_mono > 0.0
+        assert ka.last_attempt > 0.0
+
     def test_get_selection_capped(self):
         book = AddrBook(None, strict=False)
         src = _addr(1)

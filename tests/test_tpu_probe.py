@@ -91,8 +91,9 @@ class TestDeadTunnelDegrade:
             valset, block_id, commit, chain_id, height = _small_commit()
             assert valset.verify_commit(chain_id, block_id, height, commit) is None
             picked = batch_mod.get_batch_verifier()
-            assert isinstance(picked, batch_mod.TPUBatchVerifier)
-            assert picked.backend == "xla"
+            # dead tunnel -> host C path (the XLA kernel on a CPU-only host
+            # is ~100x slower per signature than cryptography's C verify)
+            assert isinstance(picked, batch_mod.HostBatchVerifier)
         finally:
             batch_mod.set_batch_verifier(saved)
 
