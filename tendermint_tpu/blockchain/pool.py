@@ -132,12 +132,16 @@ class BlockPool(BaseService):
                 self._unassign(req)
 
     # -- consumption ---------------------------------------------------------------
-    def peek_window(self, max_blocks: int) -> List[object]:
-        """The longest run of ready consecutive blocks from self.height
-        (≤ max_blocks). The windowed analogue of pool.go PeekTwoBlocks."""
+    def peek_window(self, max_blocks: int, start_offset: int = 0) -> List[object]:
+        """The longest run of ready consecutive blocks from
+        self.height + start_offset (≤ max_blocks). The windowed analogue of
+        pool.go PeekTwoBlocks; a nonzero offset peeks the NEXT window while
+        the current one is still being applied (the reactor's speculative
+        verify dispatch)."""
         out = []
         with self._mtx:
-            for h in range(self.height, self.height + max_blocks):
+            start = self.height + start_offset
+            for h in range(start, start + max_blocks):
                 req = self._requests.get(h)
                 if req is None or req.block is None:
                     break

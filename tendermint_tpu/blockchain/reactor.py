@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import threading
 import time
+from concurrent.futures import ThreadPoolExecutor
 from typing import List, Optional, Tuple
 
 import numpy as np
@@ -290,6 +291,13 @@ class BlockchainReactor(Reactor):
         self.blocks_synced = 0
         self._trusted_commit_heights: set = set()
         self._switched = threading.Event()
+        # double-buffered verify (SURVEY §2.4 pipelining): while the apply
+        # loop walks window N, window N+1's host packing + device dispatch
+        # run on this worker — the device wait releases the GIL, so verify
+        # and apply genuinely overlap.  One slot: (first_height, valset
+        # hash the speculation assumed, future, parts, blocks).
+        self._verify_exec: Optional[ThreadPoolExecutor] = None
+        self._spec: Optional[tuple] = None
 
     # -- Reactor interface --------------------------------------------------------
     def get_channels(self):
@@ -313,6 +321,10 @@ class BlockchainReactor(Reactor):
                 self.pool.stop()
             except Exception:
                 pass
+        if self._verify_exec is not None:
+            self._verify_exec.shutdown(wait=False, cancel_futures=True)
+            self._verify_exec = None
+            self._spec = None
 
     def add_peer(self, peer) -> None:
         peer.try_send(
@@ -402,15 +414,60 @@ class BlockchainReactor(Reactor):
             return self._fixed_window
         return auto_verify_window(self.state.validators.size)
 
-    def _try_sync_window(self) -> None:
-        blocks = self.pool.peek_window(self.verify_window + 1)
-        if len(blocks) < 2:
+    # -- speculative (double-buffered) verify --------------------------------------
+    def _take_speculative(self) -> Optional[tuple]:
+        """Harvest the in-flight window N+1 verification, if it still
+        applies: same start height, and the valset the speculation assumed
+        survived window N's apply (an EndBlock valset change invalidates the
+        whole speculation — including any 'wrong validators_hash' verdict it
+        produced, which must never punish a peer)."""
+        if self._spec is None:
+            return None
+        first_h, vhash, fut, parts_list, blocks = self._spec
+        self._spec = None
+        if first_h != self.pool.height or self.state.validators.hash() != vhash:
+            if not fut.cancel():
+                # already running: drain it — the single worker must be
+                # free before any new dispatch, and letting it race a
+                # fresh synchronous verify would double-dispatch the
+                # window through the device
+                try:
+                    fut.result()
+                except Exception:
+                    pass
+            return None
+        n_ok, err = fut.result()
+        return blocks, parts_list, n_ok, err
+
+    def _start_speculative(self, offset: int) -> None:
+        """Dispatch window N+1's verify while window N applies."""
+        nxt = self.pool.peek_window(self.verify_window + 1, start_offset=offset)
+        if len(nxt) < 2:
             return
+        if self._verify_exec is None:
+            self._verify_exec = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="bc-verify"
+            )
+        st = self.state  # CoW valsets: apply never mutates this snapshot
         parts_list: list = []
-        n_ok, err = verify_block_window(
-            self.state, blocks, verifier=self.verifier, parts_out=parts_list,
-            mesh=self.mesh,
+        fut = self._verify_exec.submit(
+            verify_block_window, st, nxt, self.verifier, parts_list, self.mesh
         )
+        self._spec = (nxt[0].height, st.validators.hash(), fut, parts_list, nxt)
+
+    def _try_sync_window(self) -> None:
+        spec = self._take_speculative()
+        if spec is not None:
+            blocks, parts_list, n_ok, err = spec
+        else:
+            blocks = self.pool.peek_window(self.verify_window + 1)
+            if len(blocks) < 2:
+                return
+            parts_list = []
+            n_ok, err = verify_block_window(
+                self.state, blocks, verifier=self.verifier,
+                parts_out=parts_list, mesh=self.mesh,
+            )
         for i in range(n_ok):
             self._trusted_commit_heights.add(blocks[i].height)
         if err is not None:
@@ -421,6 +478,10 @@ class BlockchainReactor(Reactor):
                 peer_id = self.pool.redo_request(h)
                 if peer_id:
                     self._stop_peer_by_id(peer_id, f"sent bad block {h}")
+        elif n_ok > 0:
+            # pipeline: verify window N+1 on the worker while the loop
+            # below applies window N (its device wait releases the GIL)
+            self._start_speculative(offset=n_ok)
         # apply the verified prefix
         for i in range(n_ok):
             block = blocks[i]
@@ -470,6 +531,10 @@ class BlockchainReactor(Reactor):
                 self.pool.stop()
             except Exception:
                 pass
+        if self._verify_exec is not None:
+            self._verify_exec.shutdown(wait=False, cancel_futures=True)
+            self._verify_exec = None
+            self._spec = None
         if self.consensus_reactor is not None:
             self.consensus_reactor.switch_to_consensus(
                 self.state.copy(), self.blocks_synced

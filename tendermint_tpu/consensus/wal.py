@@ -27,6 +27,29 @@ from tendermint_tpu.libs.service import BaseService
 
 MAX_MSG_SIZE_BYTES = 1024 * 1024  # 1MB (wal.go maxMsgSizeBytes)
 
+# native framing scanner (crc32 + uvarint + bounds over a whole chunk in one
+# call); None -> pure-Python loop below.  Same accept/reject rules and error
+# strings on both paths (tests/test_wal_fuzz.py runs the fuzz suite against
+# whichever is active; TM_NO_NATIVE_CODEC=1 forces the fallback).
+_native_scan = None
+
+
+def _get_native_scan():
+    global _native_scan
+    if _native_scan is None:
+        import os
+
+        from tendermint_tpu.encoding.native import load_ext
+
+        mod = load_ext(
+            os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "_wal_native.c"),
+            "tendermint_tpu.consensus._wal_native",
+            extra_ldflags=("-lz",),
+        )
+        _native_scan = mod.scan if mod is not None else False
+    return _native_scan or None
+
 
 class DataCorruptionError(Exception):
     """Recoverable WAL corruption point (wal.go IsDataCorruptionError)."""
@@ -87,6 +110,19 @@ class WAL(BaseService):
         reader = self.group.new_reader(start_index)
         buf = reader.read()
         reader.close()
+        scan = _get_native_scan()
+        if scan is not None:
+            spans, err = scan(buf, MAX_MSG_SIZE_BYTES)
+            for start, length in spans:
+                try:
+                    yield TimedWALMessage.unmarshal(buf[start : start + length])
+                except (EOFError, ValueError) as e:
+                    raise DataCorruptionError(
+                        f"undecodable payload: {e}"
+                    ) from e
+            if err is not None:
+                raise DataCorruptionError(err)
+            return
         pos = 0
         n = len(buf)
         while pos < n:

@@ -19,15 +19,17 @@ _HERE = os.path.dirname(os.path.abspath(__file__))
 _SOABI = sysconfig.get_config_var("SOABI")
 
 
-def _build(src: str, so: str, extra_cflags=()) -> bool:
+def _build(src: str, so: str, extra_cflags=(), extra_ldflags=()) -> bool:
     include = sysconfig.get_paths()["include"]
     cc = os.environ.get("CC", "cc")
     # unique temp path: N processes building concurrently (localnet launch)
     # must not interleave writes into one file — a corrupt .so with a fresh
     # mtime would silently disable the native codec forever
     tmp = f"{so}.{os.getpid()}.tmp"
+    # libraries go AFTER the source: GNU ld with --as-needed drops any
+    # -l<lib> it has seen no undefined references for yet
     cmd = [cc, "-O2", "-shared", "-fPIC", f"-I{include}", *extra_cflags,
-           src, "-o", tmp]
+           src, *extra_ldflags, "-o", tmp]
     try:
         res = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
     except Exception:
@@ -42,7 +44,7 @@ def _build(src: str, so: str, extra_cflags=()) -> bool:
     return True
 
 
-def load_ext(src: str, module_name: str, extra_cflags=()):
+def load_ext(src: str, module_name: str, extra_cflags=(), extra_ldflags=()):
     """Compile (if stale) and import the extension at `src`; None on failure
     or when TM_NO_NATIVE_CODEC is set."""
     if os.environ.get("TM_NO_NATIVE_CODEC"):
@@ -50,7 +52,7 @@ def load_ext(src: str, module_name: str, extra_cflags=()):
     so = os.path.splitext(src)[0] + f".{_SOABI}.so"
     try:
         if not os.path.exists(so) or os.path.getmtime(so) < os.path.getmtime(src):
-            if not _build(src, so, extra_cflags):
+            if not _build(src, so, extra_cflags, extra_ldflags):
                 return None
         spec = importlib.util.spec_from_file_location(module_name, so)
         mod = importlib.util.module_from_spec(spec)

@@ -368,6 +368,127 @@ class TestFastSyncIntegration:
             prod_bus.stop()
 
 
+class TestPipelinedVerify:
+    """SURVEY §2.4 pipelining: window N+1's verify dispatch runs on the
+    reactor's worker while window N is being applied — observed here by
+    gating the second verify call and watching the store advance past
+    window N while the gate is still closed."""
+
+    def _direct_reactor(self, fx, window, verifier, app_factory=KVStoreApp):
+        st = state_from_genesis(fx.genesis)
+        db = MemDB()
+        sm_store.save_state(db, st)
+        conn = MultiAppConn(LocalClientCreator(app_factory()))
+        conn.start()
+        store = BlockStore(MemDB())
+        bc = BlockchainReactor(
+            st, BlockExecutor(db, conn.consensus), store,
+            verifier=verifier, verify_window=window,
+        )
+        # hand the pool every block directly (no switch needed to exercise
+        # the sync loop synchronously from this thread)
+        from tendermint_tpu.blockchain.pool import _Request
+
+        for h in range(1, fx.height + 1):
+            bc.pool._requests[h] = _Request(
+                height=h, block=fx.block_store.load_block(h)
+            )
+        return bc, store
+
+    def test_speculative_verify_overlaps_apply(self):
+        fx = build_chain(n_vals=4, n_heights=12, chain_id="pipe-chain")
+
+        class GatedVerifier:
+            """Call 1 passes through; call 2 (the speculative window)
+            blocks until released."""
+
+            def __init__(self):
+                self.calls = 0
+                self.started2 = threading.Event()
+                self.release2 = threading.Event()
+
+            def verify_ed25519(self, items):
+                import numpy as np
+
+                self.calls += 1
+                if self.calls == 2:
+                    self.started2.set()
+                    assert self.release2.wait(20), "never released"
+                return np.ones((len(items),), dtype=bool)
+
+            verify_secp256k1 = verify_ed25519
+
+        gv = GatedVerifier()
+        bc, store = self._direct_reactor(fx, window=4, verifier=gv)
+        # pass 1: verifies blocks 1..4, dispatches speculation for 5..8,
+        # then applies 1..4 — all while call 2 sits at the gate
+        bc._try_sync_window()
+        assert gv.started2.wait(10), "speculative verify never dispatched"
+        assert store.height() >= 4, (
+            "apply did not proceed while the speculative verify was in "
+            f"flight (store at {store.height()})"
+        )
+        assert bc._spec is not None
+        gv.release2.set()
+        # pass 2 harvests the speculation (no third verify needed for it)
+        bc._try_sync_window()
+        assert store.height() >= 8
+        # drain the rest of the chain
+        for _ in range(4):
+            bc._try_sync_window()
+        assert store.height() == fx.height - 1  # tip's commit is in the future
+        bc.on_stop()
+
+    def test_speculation_discarded_on_valset_change(self):
+        """A valset change during window N invalidates the speculative
+        window N+1 result — it must be re-verified, never punished off the
+        stale 'wrong validators_hash' verdict."""
+        import base64
+
+        from tendermint_tpu.abci.examples.kvstore import PersistentKVStoreApp
+        from tendermint_tpu.crypto.keys import PrivKeyEd25519
+        from tendermint_tpu.types import MockPV
+
+        joiner = MockPV(PrivKeyEd25519.generate(bytes([91]) * 32))
+
+        def on_height(h, st):
+            if h == 4:  # takes effect at h6 (height + 2) — mid window 2
+                return [
+                    b"val:" + base64.b64encode(joiner.get_pub_key().bytes())
+                    + b"!50"
+                ]
+            return []
+
+        fx = build_chain(
+            n_vals=4, n_heights=12, chain_id="pipe-churn",
+            app_factory=PersistentKVStoreApp, on_height=on_height,
+            extra_pvs=[joiner],
+        )
+
+        class CountingVerifier:
+            calls = 0
+
+            def verify_ed25519(self, items):
+                import numpy as np
+
+                CountingVerifier.calls += 1
+                return np.ones((len(items),), dtype=bool)
+
+            verify_secp256k1 = verify_ed25519
+
+        punished = []
+        bc, store = self._direct_reactor(
+            fx, window=4, verifier=CountingVerifier(),
+            app_factory=PersistentKVStoreApp,
+        )
+        bc._stop_peer_by_id = lambda pid, reason: punished.append((pid, reason))
+        for _ in range(8):
+            bc._try_sync_window()
+        assert store.height() == fx.height - 1
+        assert punished == []  # stale speculation never punished anyone
+        bc.on_stop()
+
+
 class TestVerifyBlockWindowSharded:
     """The mesh path: the same window flows through parallel/commit_verify,
     sharded (heights × validators) over the virtual 8-device mesh — the

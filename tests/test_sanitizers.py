@@ -3,9 +3,10 @@ device kernels (SURVEY §5: the reference runs `make test_race`; pure-Go has
 no ASAN — our C modules get the real thing, and the JAX kernels get
 checkify/debug_nans).
 
-The ASAN/UBSAN test rebuilds _codec_native.c and _hash_native.c with
--fsanitize=address,undefined into throwaway .so files and exercises them in
-a subprocess (libasan must be LD_PRELOADed before the interpreter)."""
+The ASAN/UBSAN test rebuilds _codec_native.c, _hash_native.c and
+_wal_native.c with -fsanitize=address,undefined into throwaway .so files
+and exercises them in a subprocess (libasan must be LD_PRELOADed before
+the interpreter)."""
 
 import os
 import shutil
@@ -43,6 +44,7 @@ def load(path, name):
 # spec names must match the C modules' PyInit_<name> exports
 codec = load(sys.argv[1], "_codec_native")
 hashm = load(sys.argv[2], "_hash_native")
+walm = load(sys.argv[3], "_wal_native")
 rng = random.Random(99)
 
 # codec: write/read many randomized field sequences incl. adversarial reads
@@ -77,6 +79,28 @@ data = rng.randbytes(300000)
 assert hashm.sha256(data) == hashlib.sha256(data).digest()
 hashm.part_leaf_hashes(data, 65536)
 hashm.part_leaf_hashes(b"", 65536)
+
+# wal scanner: valid frames, random garbage, truncations, giant lengths
+import struct, zlib
+def rec(payload):
+    out = struct.pack("<I", zlib.crc32(payload))
+    v = len(payload)
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        out += bytes([b | (0x80 if v else 0)])
+        if not v:
+            break
+    return out + payload
+valid = b"".join(rec(rng.randbytes(rng.randrange(0, 120))) for _ in range(20))
+spans, err = walm.scan(valid, 1 << 20)
+assert err is None and len(spans) == 20
+for _ in range(3000):
+    walm.scan(rng.randbytes(rng.randrange(0, 300)), 1 << 20)
+for cut in range(0, len(valid), 7):
+    walm.scan(valid[:cut], 1 << 20)
+walm.scan(rec(b"x")[:5] + b"\xff" * 12, 1 << 20)  # varint torture
+walm.scan(b"", 1 << 20)
 print("SAN-WORKLOAD-OK")
 """
 
@@ -89,15 +113,17 @@ def test_native_modules_under_asan_ubsan(tmp_path):
     include = sysconfig.get_paths()["include"]
     cc = os.environ.get("CC", "gcc")
     sos = []
-    for src in (
-        os.path.join(REPO, "tendermint_tpu", "encoding", "_codec_native.c"),
-        os.path.join(REPO, "tendermint_tpu", "crypto", "_hash_native.c"),
+    for src, ldflags in (
+        (os.path.join(REPO, "tendermint_tpu", "encoding", "_codec_native.c"), ()),
+        (os.path.join(REPO, "tendermint_tpu", "crypto", "_hash_native.c"), ()),
+        (os.path.join(REPO, "tendermint_tpu", "consensus", "_wal_native.c"),
+         ("-lz",)),
     ):
         so = str(tmp_path / (os.path.basename(src)[:-2] + "_san.so"))
         res = subprocess.run(
             [cc, "-O1", "-g", "-shared", "-fPIC",
              "-fsanitize=address,undefined", "-fno-sanitize-recover=all",
-             f"-I{include}", src, "-o", so],
+             f"-I{include}", src, *ldflags, "-o", so],
             capture_output=True, text=True, timeout=180,
         )
         assert res.returncode == 0, res.stderr[-2000:]

@@ -1,0 +1,116 @@
+"""Tx indexer backends (ref: state/txindex/ — kv/kv.go, null/null.go,
+indexer_service.go): kv index/get/search by hash + tags, the null
+(disabled) backend, the node's config-driven backend selection, and the
+event-bus-driven IndexerService."""
+
+import time
+
+import pytest
+
+from tendermint_tpu.abci import types as abci
+from tendermint_tpu.libs.db.kv import MemDB
+from tendermint_tpu.state.txindex.kv import (
+    KVTxIndexer,
+    NullTxIndexer,
+    TxIndexerService,
+    TxResult,
+)
+
+
+def _result(height, index, tx, tags=()):
+    return TxResult(
+        height=height,
+        index=index,
+        tx=tx,
+        result=abci.ResponseDeliverTx(
+            code=0,
+            tags=[abci.KVPair(key=k, value=v) for k, v in tags],
+        ),
+    )
+
+
+class TestKVTxIndexer:
+    def test_index_get_roundtrip(self):
+        ix = KVTxIndexer(MemDB())
+        r = _result(5, 0, b"a=1", tags=[(b"app.creator", b"alice")])
+        ix.index(r)
+        got = ix.get(r.hash())
+        assert got is not None
+        assert (got.height, got.index, got.tx) == (5, 0, b"a=1")
+        assert got.result.code == 0
+        assert ix.get(b"\x00" * 32) is None
+
+    def test_search_by_tag_and_height(self):
+        ix = KVTxIndexer(MemDB())
+        ix.index(_result(3, 0, b"x=1", tags=[(b"app.kind", b"transfer")]))
+        ix.index(_result(4, 0, b"y=2", tags=[(b"app.kind", b"mint")]))
+        ix.index(_result(4, 1, b"z=3", tags=[(b"app.kind", b"transfer")]))
+        by_kind = ix.search("app.kind = 'transfer'")
+        assert [r.tx for r in by_kind] == [b"x=1", b"z=3"]  # (height, index) order
+        by_height = ix.search("tx.height = 4")
+        assert sorted(r.tx for r in by_height) == [b"y=2", b"z=3"]
+        both = ix.search("app.kind = 'transfer' AND tx.height = 4")
+        assert [r.tx for r in both] == [b"z=3"]
+
+    def test_search_by_hash(self):
+        ix = KVTxIndexer(MemDB())
+        r = _result(7, 2, b"q=9")
+        ix.index(r)
+        assert [x.tx for x in ix.search(f"tx.hash = '{r.hash().hex()}'")] == [b"q=9"]
+
+
+class TestNullTxIndexer:
+    def test_disabled_backend_stores_nothing(self):
+        """txindex/null/null.go:13 parity: index is a no-op, get/search
+        return nothing — the config surface for operators who want the
+        indexing cost off."""
+        ix = NullTxIndexer()
+        r = _result(1, 0, b"k=v")
+        ix.index(r)
+        assert ix.get(r.hash()) is None
+        assert ix.search("tx.height = 1") == []
+
+
+class TestConfigSelection:
+    @pytest.mark.parametrize(
+        "which,cls", [("kv", KVTxIndexer), ("null", NullTxIndexer)]
+    )
+    def test_node_backend_branch(self, which, cls):
+        """The node picks the backend off config.tx_index.indexer — the
+        same branch node/node.py takes (kv default, anything else null)."""
+        from tendermint_tpu.config.config import default_config
+
+        cfg = default_config()
+        cfg.tx_index.indexer = which
+        indexer = (
+            KVTxIndexer(MemDB())
+            if cfg.tx_index.indexer == "kv"
+            else NullTxIndexer()
+        )
+        assert isinstance(indexer, cls)
+
+
+class TestIndexerService:
+    def test_indexes_from_event_bus(self):
+        from tendermint_tpu.types.events import EventBus
+
+        bus = EventBus()
+        bus.start()
+        ix = KVTxIndexer(MemDB())
+        svc = TxIndexerService(ix, bus)
+        svc.start()
+        try:
+            bus.publish_event_tx(
+                9, 0, b"tx-bytes", abci.ResponseDeliverTx(code=0, tags=[])
+            )
+            r = _result(9, 0, b"tx-bytes")
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline:
+                if ix.get(r.hash()) is not None:
+                    break
+                time.sleep(0.02)
+            got = ix.get(r.hash())
+            assert got is not None and got.height == 9
+        finally:
+            svc.stop()
+            bus.stop()

@@ -58,6 +58,17 @@ def _decode_all(tmp_path, data: bytes, name: str):
 
 
 class TestWALFuzz:
+    @pytest.fixture(autouse=True, params=["native", "pure"])
+    def _framing_backend(self, request, monkeypatch):
+        """Every fuzz invariant holds on BOTH framing decoders — the C
+        scanner (_wal_native.scan) and the pure-Python loop it mirrors."""
+        from tendermint_tpu.consensus import wal as wal_mod
+
+        if request.param == "pure":
+            monkeypatch.setattr(wal_mod, "_native_scan", False)
+        elif wal_mod._get_native_scan() is None:
+            pytest.skip("native WAL scanner unavailable (no cc?)")
+
     def test_valid_stream_roundtrips(self, tmp_path):
         msgs = _decode_all(tmp_path, _valid_wal_bytes(8), "valid")
         assert len(msgs) == 8
@@ -113,3 +124,40 @@ class TestWALFuzz:
         rec = struct.pack("<I", zlib.crc32(payload) ^ 0xDEAD) + encode_uvarint(len(payload)) + payload
         with pytest.raises(DataCorruptionError):
             _decode_all(tmp_path, rec, "badcrc")
+
+
+class TestFramingBackendParity:
+    def test_native_and_pure_agree_on_random_input(self, tmp_path):
+        """Differential fuzz: the C scanner and the Python loop must yield
+        the SAME prefix and the SAME error text on every input."""
+        from tendermint_tpu.consensus import wal as wal_mod
+
+        if wal_mod._get_native_scan() is None:
+            pytest.skip("native WAL scanner unavailable (no cc?)")
+
+        def run(data, name, backend):
+            prev = wal_mod._native_scan
+            wal_mod._native_scan = prev if backend == "native" else False
+            try:
+                msgs = _decode_all(tmp_path, data, name)
+                return ("ok", [(m.time_ns, m.msg) for m in msgs], None)
+            except DataCorruptionError as e:
+                return ("err", None, str(e))
+            finally:
+                wal_mod._native_scan = prev
+
+        rng = random.Random(4242)
+        valid = _valid_wal_bytes(4)
+        for trial in range(250):
+            kind = trial % 3
+            if kind == 0:
+                data = rng.randbytes(rng.randrange(0, 200))
+            elif kind == 1:
+                data = valid[: rng.randrange(0, len(valid) + 1)]
+            else:
+                buf = bytearray(valid)
+                buf[rng.randrange(len(buf))] ^= 1 << rng.randrange(8)
+                data = bytes(buf)
+            a = run(data, f"diff{trial}n", "native")
+            b = run(data, f"diff{trial}p", "pure")
+            assert a == b, (trial, a, b)
