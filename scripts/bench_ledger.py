@@ -66,8 +66,8 @@ if not FAST:
         (
             "Window sweep 10,000 validators (free verifier)",
             "MAX_WINDOW_SIGS caps the auto window at 52 here "
-            "(blockchain/reactor.py:52)",
-            [PY, "scripts/bench_fastsync.py", "48", "10000", "--sweep",
+            "(blockchain/reactor.py:52); 72 blocks so the auto window runs",
+            [PY, "scripts/bench_fastsync.py", "72", "10000", "--sweep",
              "--null-verify"],
             900,
         ),
@@ -95,6 +95,12 @@ SECTIONS += [
         "runs separating fixed cost from per-window slope; op-count model "
         "in PERF.md (scripts/profile_pallas.py)",
         [PY, "scripts/profile_pallas.py"],
+        900,
+    ),
+    (
+        "Commit verify, 1k validators (bench.py 1000)",
+        "the BASELINE 1k-validator commit-verify config",
+        [PY, "bench.py", "1000"],
         900,
     ),
     (

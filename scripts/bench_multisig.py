@@ -78,6 +78,10 @@ def main():
     else:
         try:
             verifier = TPUBatchVerifier()
+            if verifier.backend != "pallas":
+                # dead tunnel: XLA-on-CPU is ~100x slower per signature
+                # than the host C path — match the production default
+                verifier = HostBatchVerifier()
         except Exception:
             verifier = HostBatchVerifier()
     ok = verify_generic(pubkeys, msgs, sigs, verifier=verifier)  # warm

@@ -44,18 +44,16 @@ def main():
 
     # ours: one batched device dispatch (warm up compile first). On a real
     # TPU the fused windowed-Straus pallas pipeline dispatches; elsewhere
-    # the portable XLA kernel. TM_JAX_PLATFORM=cpu pins the platform set
-    # BEFORE backend discovery — a dead TPU tunnel hangs, not errors.
+    # the portable XLA kernel. Device discovery goes through the subprocess
+    # liveness probe (libs/tpu_probe) — a dead TPU tunnel hangs in-process
+    # discovery, it does not error — and a dead verdict pins jax to CPU.
     import jax
 
     if os.environ.get("TM_JAX_PLATFORM"):
         jax.config.update("jax_platforms", os.environ["TM_JAX_PLATFORM"])
-    use_pallas = False
-    try:
-        jax.devices("tpu")
-        use_pallas = True
-    except Exception:
-        pass
+    from tendermint_tpu.libs.tpu_probe import safe_tpu_device
+
+    use_pallas = safe_tpu_device() is not None
     if use_pallas:
         from tendermint_tpu.ops import secp256k1_pallas as KP
 

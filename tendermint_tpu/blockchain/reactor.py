@@ -18,7 +18,7 @@ from __future__ import annotations
 
 import threading
 import time
-from concurrent.futures import ThreadPoolExecutor
+from concurrent.futures import Future
 from typing import List, Optional, Tuple
 
 import numpy as np
@@ -293,10 +293,12 @@ class BlockchainReactor(Reactor):
         self._switched = threading.Event()
         # double-buffered verify (SURVEY §2.4 pipelining): while the apply
         # loop walks window N, window N+1's host packing + device dispatch
-        # run on this worker — the device wait releases the GIL, so verify
-        # and apply genuinely overlap.  One slot: (first_height, valset
-        # hash the speculation assumed, future, parts, blocks).
-        self._verify_exec: Optional[ThreadPoolExecutor] = None
+        # run on a daemon worker thread — the device wait releases the GIL,
+        # so verify and apply genuinely overlap, and a wedged device can
+        # never block interpreter exit (a ThreadPoolExecutor's non-daemon
+        # workers would, via concurrent.futures' atexit join).  One slot:
+        # (first_height, valset hash the speculation assumed, future,
+        # parts, blocks).
         self._spec: Optional[tuple] = None
 
     # -- Reactor interface --------------------------------------------------------
@@ -321,9 +323,8 @@ class BlockchainReactor(Reactor):
                 self.pool.stop()
             except Exception:
                 pass
-        if self._verify_exec is not None:
-            self._verify_exec.shutdown(wait=False, cancel_futures=True)
-            self._verify_exec = None
+        if self._spec is not None:
+            self._spec[2].cancel()  # not-yet-started work never runs
             self._spec = None
 
     def add_peer(self, peer) -> None:
@@ -444,15 +445,26 @@ class BlockchainReactor(Reactor):
         nxt = self.pool.peek_window(self.verify_window + 1, start_offset=offset)
         if len(nxt) < 2:
             return
-        if self._verify_exec is None:
-            self._verify_exec = ThreadPoolExecutor(
-                max_workers=1, thread_name_prefix="bc-verify"
-            )
         st = self.state  # CoW valsets: apply never mutates this snapshot
         parts_list: list = []
-        fut = self._verify_exec.submit(
-            verify_block_window, st, nxt, self.verifier, parts_list, self.mesh
-        )
+        fut: Future = Future()
+
+        def _run():
+            # honor a cancel that lands before the thread gets scheduled;
+            # once running, fut.cancel() returns False and harvest/discard
+            # paths drain instead of racing a second dispatch
+            if not fut.set_running_or_notify_cancel():
+                return
+            try:
+                fut.set_result(
+                    verify_block_window(
+                        st, nxt, self.verifier, parts_list, self.mesh
+                    )
+                )
+            except BaseException as e:
+                fut.set_exception(e)
+
+        threading.Thread(target=_run, name="bc-verify", daemon=True).start()
         self._spec = (nxt[0].height, st.validators.hash(), fut, parts_list, nxt)
 
     def _try_sync_window(self) -> None:
@@ -531,10 +543,16 @@ class BlockchainReactor(Reactor):
                 self.pool.stop()
             except Exception:
                 pass
-        if self._verify_exec is not None:
-            self._verify_exec.shutdown(wait=False, cancel_futures=True)
-            self._verify_exec = None
+        if self._spec is not None:
+            fut = self._spec[2]
             self._spec = None
+            if not fut.cancel():
+                # drain: the device must be idle before consensus starts
+                # its own commit verifies on it
+                try:
+                    fut.result()
+                except Exception:
+                    pass
         if self.consensus_reactor is not None:
             self.consensus_reactor.switch_to_consensus(
                 self.state.copy(), self.blocks_synced
