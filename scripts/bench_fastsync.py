@@ -49,6 +49,13 @@ class NullVerifier:
 
     verify_secp256k1 = verify_ed25519
 
+    def verify_ed25519_raw(self, pubs, msgs, sigs):
+        # column form: the ceiling must measure the same fast path the
+        # production verifiers take (crypto/batch.py verify_ed25519_raw)
+        import numpy as np
+
+        return np.ones((len(pubs),), dtype=bool)
+
 
 def _fresh_executor(genesis):
     from tendermint_tpu.abci.examples.kvstore import KVStoreApp

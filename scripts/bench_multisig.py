@@ -33,11 +33,7 @@ BASELINE_SAMPLE = 200  # serial aggregates to time (extrapolated)
 
 def main():
     from tendermint_tpu.crypto import ed25519 as ed
-    from tendermint_tpu.crypto.batch import (
-        HostBatchVerifier,
-        TPUBatchVerifier,
-        verify_generic,
-    )
+    from tendermint_tpu.crypto.batch import verify_generic
     from tendermint_tpu.crypto.keys import PubKeyEd25519
     from tendermint_tpu.crypto.multisig import (
         Multisignature,
@@ -72,18 +68,12 @@ def main():
         assert pubkeys[i].verify_bytes(msgs[i], sigs[i])
     baseline_s = (time.perf_counter() - t0) * (N_VALS / sample)
 
-    # --- ours: one flattened batch dispatch ---
-    if os.environ.get("TM_BATCH_VERIFIER", "").lower() == "host":
-        verifier = HostBatchVerifier()
-    else:
-        try:
-            verifier = TPUBatchVerifier()
-            if verifier.backend != "pallas":
-                # dead tunnel: XLA-on-CPU is ~100x slower per signature
-                # than the host C path — match the production default
-                verifier = HostBatchVerifier()
-        except Exception:
-            verifier = HostBatchVerifier()
+    # --- ours: one flattened batch dispatch, through the PRODUCTION
+    # selection (TM_BATCH_VERIFIER override incl. forced xla; probed
+    # pallas on a live chip; host fallback on a dead tunnel) ---
+    from tendermint_tpu.crypto.batch import get_batch_verifier
+
+    verifier = get_batch_verifier()
     ok = verify_generic(pubkeys, msgs, sigs, verifier=verifier)  # warm
     assert bool(np.all(ok)), "batched multisig verify rejected valid aggregates"
     times = []

@@ -18,7 +18,7 @@ from __future__ import annotations
 
 import threading
 import time
-from concurrent.futures import Future
+from concurrent.futures import CancelledError, Future
 from typing import List, Optional, Tuple
 
 import numpy as np
@@ -323,9 +323,10 @@ class BlockchainReactor(Reactor):
                 self.pool.stop()
             except Exception:
                 pass
-        if self._spec is not None:
-            self._spec[2].cancel()  # not-yet-started work never runs
-            self._spec = None
+        spec = self._spec  # snapshot: the pool routine clears this slot
+        self._spec = None
+        if spec is not None:
+            spec[2].cancel()  # not-yet-started work never runs
 
     def add_peer(self, peer) -> None:
         peer.try_send(
@@ -434,10 +435,14 @@ class BlockchainReactor(Reactor):
                 # window through the device
                 try:
                     fut.result()
-                except Exception:
+                except BaseException:
                     pass
             return None
-        n_ok, err = fut.result()
+        try:
+            n_ok, err = fut.result()
+        except CancelledError:
+            # on_stop cancelled the slot from another thread mid-harvest
+            return None
         return blocks, parts_list, n_ok, err
 
     def _start_speculative(self, offset: int) -> None:
@@ -543,16 +548,20 @@ class BlockchainReactor(Reactor):
                 self.pool.stop()
             except Exception:
                 pass
-        if self._spec is not None:
-            fut = self._spec[2]
-            self._spec = None
-            if not fut.cancel():
-                # drain: the device must be idle before consensus starts
-                # its own commit verifies on it
-                try:
-                    fut.result()
-                except Exception:
-                    pass
+        spec = self._spec
+        self._spec = None
+        if spec is not None and not spec[2].cancel():
+            # drain: the device should be idle before consensus starts its
+            # own commit verifies — but BOUNDED: a wedged tunnel must not
+            # hold the switch to consensus hostage (the daemon worker dies
+            # with the process either way)
+            try:
+                spec[2].result(timeout=30.0)
+            except BaseException:
+                self.logger.warning(
+                    "speculative verify did not drain before consensus "
+                    "switchover (wedged device dispatch?)"
+                )
         if self.consensus_reactor is not None:
             self.consensus_reactor.switch_to_consensus(
                 self.state.copy(), self.blocks_synced
