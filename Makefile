@@ -3,7 +3,7 @@
 PYTHON ?= python
 
 .PHONY: test test_slow test_sanitizers bench bench-local bench_fastsync \
-        bench_secp bench_multisig localnet-start localnet-stop \
+        bench_secp bench_multisig metrics-lint localnet-start localnet-stop \
         build-docker-localnode
 
 test:
@@ -32,6 +32,11 @@ bench_secp:
 
 bench_multisig:
 	$(PYTHON) scripts/bench_multisig.py 1000 3 5
+
+# strict text-format v0.0.4 self-check of Registry.expose_text(); pass files
+# to lint scrape snapshots: make metrics-lint ARGS="/tmp/m.prom"
+metrics-lint:
+	JAX_PLATFORMS=cpu $(PYTHON) scripts/metrics_lint.py $(ARGS)
 
 build-docker-localnode:
 	docker build -t tendermint_tpu/localnode networks/local/localnode

@@ -1,0 +1,38 @@
+"""--metrics-out support shared by the bench_* scripts.
+
+`--metrics-out PATH` (or `--metrics-out=PATH`) snapshots the process-wide
+verify metric families (`Registry.expose_text()`, Prometheus text format
+v0.0.4) to PATH next to the JSON ledger line — per-stage breakdowns (batch
+sizes, per-backend dispatch/compile latency, fallback counts) to go with
+the end-to-end number.
+"""
+
+import sys
+from typing import Optional
+
+
+def pop_metrics_out(argv=None) -> Optional[str]:
+    """Remove --metrics-out PATH (or --metrics-out=PATH) from argv and
+    return PATH, so the scripts' positional arg parsing stays untouched."""
+    argv = sys.argv if argv is None else argv
+    for i, a in enumerate(argv):
+        if a == "--metrics-out":
+            if i + 1 >= len(argv):
+                raise SystemExit("--metrics-out needs a path")
+            path = argv[i + 1]
+            del argv[i : i + 2]
+            return path
+        if a.startswith("--metrics-out="):
+            del argv[i]
+            return a.split("=", 1)[1]
+    return None
+
+
+def write_snapshot(path: Optional[str]) -> None:
+    if not path:
+        return
+    from tendermint_tpu.libs.metrics import get_verify_metrics
+
+    with open(path, "w") as f:
+        f.write(get_verify_metrics().registry.expose_text())
+    print(f"# metrics snapshot -> {path}", file=sys.stderr)

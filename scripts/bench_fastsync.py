@@ -10,6 +10,7 @@ Measures the verify→apply pipeline blocks/s on a pre-built signed chain:
 
 Usage: python scripts/bench_fastsync.py [n_blocks] [n_vals] [window]
        python scripts/bench_fastsync.py [n_blocks] [n_vals] --sweep
+       ... [--metrics-out PATH]  # Prometheus snapshot of the verify families
 Prints one JSON line: {"metric": "fastsync_replay", "value": blocks/s, ...}
 --sweep instead re-runs the verify+apply pipeline over a ladder of window
 sizes and prints one JSON line per window (how VERIFY_WINDOW's default was
@@ -27,6 +28,9 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from _bench_metrics import pop_metrics_out, write_snapshot  # noqa: E402
+
+METRICS_OUT = pop_metrics_out()
 _pos = [a for a in sys.argv[1:] if not a.startswith("--")]
 N_BLOCKS = int(_pos[0]) if len(_pos) > 0 else 2048
 N_VALS = int(_pos[1]) if len(_pos) > 1 else 64
@@ -191,6 +195,7 @@ def main():
                 ),
                 flush=True,
             )
+        write_snapshot(METRICS_OUT)
         return
 
     ours_rate = run_pipeline(WINDOW)
@@ -205,6 +210,7 @@ def main():
             }
         )
     )
+    write_snapshot(METRICS_OUT)
 
 
 if __name__ == "__main__":

@@ -163,6 +163,13 @@ def main():
         "named bench script run as a subprocess under a hard timeout; "
         "sections that need the TPU tunnel degrade or time out without it.",
         "",
+        "For per-stage breakdowns behind any end-to-end row, rerun the "
+        "bench with `--metrics-out PATH` (bench_fastsync / bench_secp / "
+        "bench_multisig): it snapshots the `tendermint_verify_*` metric "
+        "families (batch sizes, per-backend dispatch/compile latency, "
+        "fallback counts) in Prometheus text format — lint with "
+        "`make metrics-lint ARGS=PATH`.",
+        "",
         f"- generated: {datetime.datetime.now(datetime.timezone.utc):%Y-%m-%d %H:%M} UTC",
         f"- git: `{rev}`",
         f"- host: {platform.processor() or platform.machine()}, "

@@ -25,6 +25,9 @@ import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from _bench_metrics import pop_metrics_out, write_snapshot  # noqa: E402
+
+METRICS_OUT = pop_metrics_out()
 N_VALS = int(sys.argv[1]) if len(sys.argv) > 1 else 1000
 K = int(sys.argv[2]) if len(sys.argv) > 2 else 3
 N_KEYS = int(sys.argv[3]) if len(sys.argv) > 3 else 5
@@ -93,6 +96,7 @@ def main():
             }
         )
     )
+    write_snapshot(METRICS_OUT)
 
 
 if __name__ == "__main__":

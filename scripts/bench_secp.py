@@ -13,6 +13,9 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from _bench_metrics import pop_metrics_out, write_snapshot  # noqa: E402
+
+METRICS_OUT = pop_metrics_out()
 N_VALS = int(sys.argv[1]) if len(sys.argv) > 1 else 1024
 BASELINE_SAMPLE = 256
 
@@ -80,6 +83,7 @@ def main():
             }
         )
     )
+    write_snapshot(METRICS_OUT)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,338 @@
+"""Strict Prometheus text-format v0.0.4 linter (`make metrics-lint`).
+
+Parses an exposition the hard way — char-level label-value unescaping, no
+regex-over-the-whole-line shortcuts — and fails on everything a real scraper
+would choke on or silently misread:
+
+  * malformed metric/label names, bad escapes (only \\\\, \\", \\n are legal
+    in label values; \\\\ and \\n in HELP), unterminated quotes;
+  * duplicate series (same name + same labelset) and duplicate HELP/TYPE;
+  * TYPE after samples of the same family, unknown TYPE values;
+  * unparseable sample values / timestamps;
+  * histogram shape: missing le, missing +Inf bucket, non-cumulative bucket
+    counts, +Inf bucket != _count.
+
+Usage:
+    python scripts/metrics_lint.py FILE [FILE ...]   # lint scrape snapshots
+    python scripts/metrics_lint.py                   # self-check mode
+
+Self-check mode builds registries that exercise labeled histograms and every
+escaping edge (backslash, quote, newline in label values and HELP) and lints
+their `Registry.expose_text()` — the tier-1 suite runs this as a fast test
+(tests/test_metrics_trace.py), so an escaping regression fails CI before it
+corrupts a scrape.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_NAME_START = set("abcdefghijklmnopqrstuvwxyzABCDEFGHIJKLMNOPQRSTUVWXYZ_:")
+_NAME_CONT = _NAME_START | set("0123456789")
+_LABEL_START = _NAME_START - {":"}
+_LABEL_CONT = _NAME_CONT - {":"}
+_TYPES = {"counter", "gauge", "histogram", "summary", "untyped"}
+
+
+def _valid_name(s, start, cont):
+    return bool(s) and s[0] in start and all(c in cont for c in s[1:])
+
+
+def _parse_value(s):
+    s = s.strip()
+    if s in ("+Inf", "Inf"):
+        return float("inf")
+    if s == "-Inf":
+        return float("-inf")
+    if s == "NaN":
+        return float("nan")
+    return float(s)  # raises ValueError
+
+
+def _unescape_help(s, err):
+    """HELP text: only \\\\ and \\n escapes are defined."""
+    out, i = [], 0
+    while i < len(s):
+        c = s[i]
+        if c == "\\":
+            if i + 1 >= len(s):
+                err("trailing backslash in HELP text")
+                break
+            nxt = s[i + 1]
+            if nxt == "\\":
+                out.append("\\")
+            elif nxt == "n":
+                out.append("\n")
+            else:
+                err(f"illegal HELP escape \\{nxt}")
+            i += 2
+        else:
+            out.append(c)
+            i += 1
+    return "".join(out)
+
+
+def _parse_labels(s, pos, err):
+    """Parse `{name="value",...}` starting at s[pos] == '{'.
+    Returns (labels: tuple of (k, v), next_pos) or (None, pos) on error."""
+    labels = []
+    i = pos + 1
+    while True:
+        while i < len(s) and s[i] == " ":
+            i += 1
+        if i < len(s) and s[i] == "}":
+            return tuple(labels), i + 1
+        j = i
+        while j < len(s) and s[j] not in ('=', '{', '}', '"', ','):
+            j += 1
+        lname = s[i:j].strip()
+        if not _valid_name(lname, _LABEL_START, _LABEL_CONT):
+            err(f"bad label name {lname!r}")
+            return None, pos
+        if j >= len(s) or s[j] != "=":
+            err(f"expected '=' after label name {lname!r}")
+            return None, pos
+        j += 1
+        if j >= len(s) or s[j] != '"':
+            err(f"label value for {lname!r} not quoted")
+            return None, pos
+        j += 1
+        val = []
+        while True:
+            if j >= len(s):
+                err(f"unterminated label value for {lname!r}")
+                return None, pos
+            c = s[j]
+            if c == "\\":
+                if j + 1 >= len(s):
+                    err(f"trailing backslash in label value for {lname!r}")
+                    return None, pos
+                nxt = s[j + 1]
+                if nxt == "\\":
+                    val.append("\\")
+                elif nxt == '"':
+                    val.append('"')
+                elif nxt == "n":
+                    val.append("\n")
+                else:
+                    err(f"illegal escape \\{nxt} in label value for {lname!r}")
+                    return None, pos
+                j += 2
+            elif c == '"':
+                j += 1
+                break
+            else:
+                val.append(c)
+                j += 1
+        labels.append((lname, "".join(val)))
+        if j < len(s) and s[j] == ",":
+            j += 1
+        i = j
+
+
+def lint_text(text):
+    """Returns a list of 'line N: problem' strings (empty = clean)."""
+    errors = []
+    helps = {}
+    types = {}
+    sampled = set()  # family names that have emitted samples
+    series = {}  # (name, labels tuple) -> first line no
+    # histogram consistency bookkeeping:
+    buckets = {}  # base name -> list of (le float, labels-minus-le, count)
+    counts = {}  # (base name, labels) -> _count value
+
+    if text and not text.endswith("\n"):
+        errors.append("exposition does not end with a newline")
+
+    for lineno, line in enumerate(text.split("\n"), 1):
+        if line == "":
+            continue
+
+        def err(msg, lineno=lineno, line=line):
+            errors.append(f"line {lineno}: {msg} | {line!r}")
+
+        if line.startswith("#"):
+            parts = line.split(" ", 3)
+            if len(parts) >= 2 and parts[1] in ("HELP", "TYPE"):
+                if len(parts) < 4 and parts[1] == "TYPE":
+                    err("TYPE line needs a metric name and a type")
+                    continue
+                if len(parts) < 3:
+                    err(f"{parts[1]} line needs a metric name")
+                    continue
+                name = parts[2]
+                if not _valid_name(name, _NAME_START, _NAME_CONT):
+                    err(f"bad metric name {name!r}")
+                    continue
+                if parts[1] == "HELP":
+                    if name in helps:
+                        err(f"duplicate HELP for {name}")
+                    helps[name] = _unescape_help(
+                        parts[3] if len(parts) > 3 else "", err
+                    )
+                else:
+                    kind = parts[3].strip()
+                    if kind not in _TYPES:
+                        err(f"unknown TYPE {kind!r}")
+                    if name in types:
+                        err(f"duplicate TYPE for {name}")
+                    if name in sampled:
+                        err(f"TYPE for {name} after its samples")
+                    types[name] = kind
+            # other comments are legal and ignored
+            continue
+
+        # sample line: name[{labels}] value [timestamp]
+        i = 0
+        while i < len(line) and line[i] not in ("{", " "):
+            i += 1
+        name = line[:i]
+        if not _valid_name(name, _NAME_START, _NAME_CONT):
+            err(f"bad metric name {name!r}")
+            continue
+        labels = ()
+        if i < len(line) and line[i] == "{":
+            labels, i = _parse_labels(line, i, err)
+            if labels is None:
+                continue
+        rest = line[i:].strip().split()
+        if not rest:
+            err("missing sample value")
+            continue
+        if len(rest) > 2:
+            err(f"trailing garbage after value: {rest[2:]!r}")
+            continue
+        try:
+            value = _parse_value(rest[0])
+        except ValueError:
+            err(f"unparseable sample value {rest[0]!r}")
+            continue
+        if len(rest) == 2:
+            try:
+                int(rest[1])
+            except ValueError:
+                err(f"unparseable timestamp {rest[1]!r}")
+                continue
+        key = (name, labels)
+        if key in series:
+            err(f"duplicate series (first at line {series[key]})")
+            continue
+        series[key] = lineno
+        # family bookkeeping: histogram child series belong to the base name
+        family = name
+        for suffix in ("_bucket", "_sum", "_count"):
+            if name.endswith(suffix) and types.get(name[: -len(suffix)]) == "histogram":
+                family = name[: -len(suffix)]
+                break
+        sampled.add(family)
+        if family != name and name.endswith("_bucket"):
+            les = [v for k, v in labels if k == "le"]
+            if len(les) != 1:
+                err(f"histogram bucket of {family} needs exactly one le label")
+                continue
+            try:
+                le = _parse_value(les[0])
+            except ValueError:
+                err(f"unparseable le value {les[0]!r}")
+                continue
+            other = tuple((k, v) for k, v in labels if k != "le")
+            buckets.setdefault(family, []).append((le, other, value, lineno))
+        elif family != name and name.endswith("_count"):
+            counts[(family, labels)] = (value, lineno)
+
+    # histogram shape checks
+    for family, entries in buckets.items():
+        per_series = {}
+        for le, other, value, lineno in entries:
+            per_series.setdefault(other, []).append((le, value, lineno))
+        for other, rows in per_series.items():
+            rows.sort(key=lambda r: r[0])
+            prev = None
+            for le, value, lineno in rows:
+                if prev is not None and value < prev:
+                    errors.append(
+                        f"line {lineno}: histogram {family}{dict(other)} "
+                        f"buckets not cumulative (le={le}: {value} < {prev})"
+                    )
+                prev = value
+            if not rows or rows[-1][0] != float("inf"):
+                errors.append(
+                    f"histogram {family}{dict(other)} missing +Inf bucket"
+                )
+                continue
+            cnt = counts.get((family, other))
+            if cnt is not None and cnt[0] != rows[-1][1]:
+                errors.append(
+                    f"line {cnt[1]}: histogram {family}{dict(other)} _count "
+                    f"{cnt[0]} != +Inf bucket {rows[-1][1]}"
+                )
+    return errors
+
+
+def _self_check():
+    """Exercise labeled histograms and every escaping edge, then lint."""
+    from tendermint_tpu.libs.metrics import (
+        NodeMetrics,
+        Registry,
+        VerifyMetrics,
+    )
+
+    r = Registry()
+    c = r.counter("lint_escapes_total", 'help with \\ backslash\nand newline',
+                  label_names=("path", "quote"))
+    c.add(1.0, ('C:\\temp\n"dir"', 'say "hi"'))
+    c.add(2.0, ("plain", "values"))
+    h = r.histogram("lint_latency_seconds", "labeled histogram",
+                    buckets=(0.1, 1.0), label_names=("backend",))
+    h.observe(0.05, ("host",))
+    h.observe(5.0, ("pallas\\tpu",))
+    g = r.gauge("lint_height", "a gauge")
+    g.set(42)
+
+    vm = VerifyMetrics()
+    vm.record_dispatch("host", "ed25519", 64, 0.012, rejects=1, first=True)
+    vm.record_dispatch("xla", "secp256k1", 128, 0.3)
+    vm.host_fallback.add(1.0, ("no_tpu",))
+    vm.speculative.add(3.0, ("hit",))
+    vm.window_heights.observe(512.0)
+
+    nm = NodeMetrics()
+
+    failures = []
+    for label, text in (
+        ("escaping registry", r.expose_text()),
+        ("VerifyMetrics", vm.registry.expose_text()),
+        ("NodeMetrics(+verify attached)", nm.registry.expose_text()),
+    ):
+        errs = lint_text(text)
+        if errs:
+            failures.append((label, errs))
+    return failures
+
+
+def main(argv):
+    if argv:
+        rc = 0
+        for path in argv:
+            with open(path) as f:
+                errs = lint_text(f.read())
+            if errs:
+                rc = 1
+                for e in errs:
+                    print(f"{path}: {e}", file=sys.stderr)
+            else:
+                print(f"{path}: OK")
+        return rc
+    failures = _self_check()
+    if failures:
+        for label, errs in failures:
+            for e in errs:
+                print(f"self-check [{label}]: {e}", file=sys.stderr)
+        return 1
+    print("metrics-lint self-check: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -34,6 +34,8 @@ from tendermint_tpu.blockchain.messages import (
 )
 from tendermint_tpu.blockchain.pool import BlockPool
 from tendermint_tpu.crypto.batch import verify_generic
+from tendermint_tpu.libs import trace
+from tendermint_tpu.libs.metrics import get_verify_metrics
 from tendermint_tpu.p2p.base_reactor import Reactor
 from tendermint_tpu.p2p.conn.connection import ChannelDescriptor
 from tendermint_tpu.types import BlockID
@@ -268,8 +270,10 @@ class BlockchainReactor(Reactor):
         verifier=None,  # BatchVerifier for the window dispatches
         verify_window: Optional[int] = None,  # None → auto by valset size
         mesh=None,  # device mesh: shard windows via parallel/commit_verify
+        metrics=None,  # NodeMetrics — fast_syncing gauge + block-timer reset
     ):
         super().__init__(name="BlockchainReactor")
+        self.metrics = metrics
         self.initial_state = state
         self.state = state.copy()
         self.block_exec = block_exec
@@ -312,6 +316,8 @@ class BlockchainReactor(Reactor):
 
     def on_start(self) -> None:
         if self.fast_sync:
+            if self.metrics is not None:
+                self.metrics.fast_syncing.set(1)
             self.pool.start()
             threading.Thread(
                 target=self._pool_routine, name="bc-pool", daemon=True
@@ -428,6 +434,7 @@ class BlockchainReactor(Reactor):
         first_h, vhash, fut, parts_list, blocks = self._spec
         self._spec = None
         if first_h != self.pool.height or self.state.validators.hash() != vhash:
+            get_verify_metrics().speculative.add(1.0, ("miss",))
             if not fut.cancel():
                 # already running: drain it — the single worker must be
                 # free before any new dispatch, and letting it race a
@@ -442,7 +449,9 @@ class BlockchainReactor(Reactor):
             n_ok, err = fut.result()
         except CancelledError:
             # on_stop cancelled the slot from another thread mid-harvest
+            get_verify_metrics().speculative.add(1.0, ("miss",))
             return None
+        get_verify_metrics().speculative.add(1.0, ("hit",))
         return blocks, parts_list, n_ok, err
 
     def _start_speculative(self, offset: int) -> None:
@@ -461,11 +470,15 @@ class BlockchainReactor(Reactor):
             if not fut.set_running_or_notify_cancel():
                 return
             try:
-                fut.set_result(
-                    verify_block_window(
-                        st, nxt, self.verifier, parts_list, self.mesh
+                with trace.span(
+                    "fastsync.window", h0=nxt[0].height, n=len(nxt) - 1,
+                    mode="speculative",
+                ):
+                    fut.set_result(
+                        verify_block_window(
+                            st, nxt, self.verifier, parts_list, self.mesh
+                        )
                     )
-                )
             except BaseException as e:
                 fut.set_exception(e)
 
@@ -481,10 +494,18 @@ class BlockchainReactor(Reactor):
             if len(blocks) < 2:
                 return
             parts_list = []
-            n_ok, err = verify_block_window(
-                self.state, blocks, verifier=self.verifier,
-                parts_out=parts_list, mesh=self.mesh,
-            )
+            with trace.span(
+                "fastsync.window", h0=blocks[0].height, n=len(blocks) - 1,
+                mode="sync",
+            ):
+                n_ok, err = verify_block_window(
+                    self.state, blocks, verifier=self.verifier,
+                    parts_out=parts_list, mesh=self.mesh,
+                )
+        try:
+            get_verify_metrics().window_heights.observe(float(n_ok))
+        except Exception:
+            pass
         for i in range(n_ok):
             self._trusted_commit_heights.add(blocks[i].height)
         if err is not None:
@@ -500,6 +521,12 @@ class BlockchainReactor(Reactor):
             # below applies window N (its device wait releases the GIL)
             self._start_speculative(offset=n_ok)
         # apply the verified prefix
+        if n_ok == 0:
+            return
+        with trace.span("fastsync.apply", h0=blocks[0].height, n=n_ok):
+            self._apply_verified(blocks, parts_list, n_ok)
+
+    def _apply_verified(self, blocks, parts_list, n_ok: int) -> None:
         for i in range(n_ok):
             block = blocks[i]
             parts = parts_list[i]
@@ -543,6 +570,12 @@ class BlockchainReactor(Reactor):
             self.store.height(), self.blocks_synced,
         )
         self.fast_sync = False
+        if self.metrics is not None:
+            self.metrics.fast_syncing.set(0)
+            # the monotonic block timer predates the fast-synced blocks —
+            # without a reset the first consensus block records a bogus
+            # interval spanning the whole sync
+            self.metrics.reset_block_timer()
         if self.pool.is_running:
             try:
                 self.pool.stop()

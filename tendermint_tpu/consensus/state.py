@@ -35,6 +35,7 @@ from tendermint_tpu.consensus.messages import (
 )
 from tendermint_tpu.consensus.ticker import TimeoutTicker
 from tendermint_tpu.consensus.wal import NilWAL, WAL
+from tendermint_tpu.libs import trace
 from tendermint_tpu.libs.events import EventSwitch
 from tendermint_tpu.libs.service import BaseService
 from tendermint_tpu.types import (
@@ -256,6 +257,10 @@ class ConsensusState(BaseService):
             )
 
     def _new_step(self) -> None:
+        trace.instant(
+            "consensus.step",
+            height=self.rs.height, round=self.rs.round, step=self.rs.step.name,
+        )
         self.wal.write(EventRoundStep(self.rs.height, self.rs.round, int(self.rs.step)))
         self.n_steps += 1
         self._publish_rs_event(EVENT_NEW_ROUND_STEP)
@@ -734,6 +739,10 @@ class ConsensusState(BaseService):
         self._finalize_commit(height)
 
     def _finalize_commit(self, height: int) -> None:
+        with trace.span("consensus.finalize_commit", height=height):
+            self._do_finalize_commit(height)
+
+    def _do_finalize_commit(self, height: int) -> None:
         from tendermint_tpu.libs import fail
 
         rs = self.rs

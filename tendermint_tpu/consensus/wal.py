@@ -22,6 +22,7 @@ from tendermint_tpu.consensus.messages import (
     encode_msg,
 )
 from tendermint_tpu.encoding.codec import Reader, Writer, encode_uvarint, read_uvarint
+from tendermint_tpu.libs import trace
 from tendermint_tpu.libs.autofile import Group
 from tendermint_tpu.libs.service import BaseService
 
@@ -86,14 +87,16 @@ class WAL(BaseService):
         if len(payload) > MAX_MSG_SIZE_BYTES:
             raise ValueError(f"WAL msg too big: {len(payload)}")
         rec = struct.pack("<I", zlib.crc32(payload)) + encode_uvarint(len(payload)) + payload
-        self.group.write(rec)
-        self.group.flush()
+        with trace.span("wal.append", bytes=len(rec)):
+            self.group.write(rec)
+            self.group.flush()
 
     def write_sync(self, msg: object) -> None:
         """Append + fsync (internal msgs and #ENDHEIGHT use this)."""
         self.write(msg)
         if self.is_running:
-            self.group.sync()
+            with trace.span("wal.fsync"):
+                self.group.sync()
 
     def on_start(self) -> None:
         self.group.maybe_rotate()

@@ -22,12 +22,28 @@ Accept/reject is bit-exact across backends (tests/test_ops_ed25519.py).
 from __future__ import annotations
 
 import threading
+import time
 from typing import List, NamedTuple, Optional, Sequence, Tuple
 
 import numpy as np
 
 from tendermint_tpu.crypto import ed25519 as _ed
 from tendermint_tpu.crypto.keys import PubKey, PubKeyEd25519
+from tendermint_tpu.libs import trace
+from tendermint_tpu.libs.metrics import get_verify_metrics
+
+
+def _record_dispatch(backend: str, algo: str, n: int, t0: float, ok,
+                     first: bool = False) -> None:
+    """One VerifyMetrics record per batch dispatch (size, latency, rejects).
+    Telemetry must never take down the verify path."""
+    try:
+        get_verify_metrics().record_dispatch(
+            backend, algo, n, time.perf_counter() - t0,
+            rejects=n - int(np.count_nonzero(ok)), first=first,
+        )
+    except Exception:
+        pass
 
 
 class SigItem(NamedTuple):
@@ -46,20 +62,31 @@ class HostBatchVerifier:
     name = "host"
 
     def verify_ed25519(self, items: Sequence[SigItem]) -> np.ndarray:
-        return np.array(
-            [_ed.verify(it.pubkey, it.msg, it.sig) for it in items], dtype=bool
-        )
+        t0 = time.perf_counter()
+        with trace.span("verify.dispatch", backend="host", algo="ed25519",
+                        n=len(items)):
+            ok = np.array(
+                [_ed.verify(it.pubkey, it.msg, it.sig) for it in items],
+                dtype=bool,
+            )
+        _record_dispatch("host", "ed25519", len(items), t0, ok)
+        return ok
 
     def verify_ed25519_raw(self, pubs, msgs, sigs) -> np.ndarray:
         """Parallel-sequence form of verify_ed25519 — the hot callers
         (verify_generic's homogeneous fast path) already hold the three
         columns, and building |window|x|valset| SigItems was a measured
         slice of the fast-sync host ceiling."""
+        t0 = time.perf_counter()
         verify = _ed.verify
-        return np.fromiter(
-            (verify(p, m, s) for p, m, s in zip(pubs, msgs, sigs)),
-            dtype=bool, count=len(pubs),
-        )
+        with trace.span("verify.dispatch", backend="host", algo="ed25519",
+                        n=len(pubs)):
+            ok = np.fromiter(
+                (verify(p, m, s) for p, m, s in zip(pubs, msgs, sigs)),
+                dtype=bool, count=len(pubs),
+            )
+        _record_dispatch("host", "ed25519", len(pubs), t0, ok)
+        return ok
 
     def verify_secp256k1(self, items: Sequence[SigItem]) -> np.ndarray:
         """items carry (33B compressed pubkey, RAW msg, DER sig); the SHA-256
@@ -67,10 +94,15 @@ class HostBatchVerifier:
         from tendermint_tpu.crypto import secp256k1 as _secp
         from tendermint_tpu.crypto.hashing import sha256
 
-        return np.array(
-            [_secp.verify(it.pubkey, sha256(it.msg), it.sig) for it in items],
-            dtype=bool,
-        )
+        t0 = time.perf_counter()
+        with trace.span("verify.dispatch", backend="host", algo="secp256k1",
+                        n=len(items)):
+            ok = np.array(
+                [_secp.verify(it.pubkey, sha256(it.msg), it.sig) for it in items],
+                dtype=bool,
+            )
+        _record_dispatch("host", "secp256k1", len(items), t0, ok)
+        return ok
 
 
 def _find_tpu_device():
@@ -122,6 +154,9 @@ class TPUBatchVerifier:
         else:
             from tendermint_tpu.ops import ed25519_verify as kernel
         self._kernel = kernel
+        # algos that have dispatched at least once on this verifier — the
+        # first dispatch pays compile/upload and lands in compile_seconds
+        self._warm: set = set()
 
     def verify_ed25519(self, items: Sequence[SigItem]) -> np.ndarray:
         if len(items) == 0:
@@ -136,20 +171,29 @@ class TPUBatchVerifier:
         """Column form of verify_ed25519 (see HostBatchVerifier's note)."""
         if len(pubs) == 0:
             return np.zeros((0,), dtype=bool)
-        pubs_a = np.frombuffer(b"".join(pubs), dtype=np.uint8).reshape(
-            len(pubs), 32
-        )
-        sigs_a = np.frombuffer(b"".join(sigs), dtype=np.uint8).reshape(
-            len(sigs), 64
-        )
-        if self.backend == "pallas":
-            import jax
+        t0 = time.perf_counter()
+        first = "ed25519" not in self._warm
+        with trace.span("verify.dispatch", backend=self.backend,
+                        algo="ed25519", n=len(pubs)):
+            pubs_a = np.frombuffer(b"".join(pubs), dtype=np.uint8).reshape(
+                len(pubs), 32
+            )
+            sigs_a = np.frombuffer(b"".join(sigs), dtype=np.uint8).reshape(
+                len(sigs), 64
+            )
+            if self.backend == "pallas":
+                import jax
 
-            dev = None if jax.default_backend() == "tpu" else self._tpu
-            ok = self._kernel.verify_batch(pubs_a, msgs, sigs_a, device=dev)
-        else:
-            ok = self._kernel.verify_batch(pubs_a, msgs, sigs_a, mesh=self._mesh)
-        return np.asarray(ok, dtype=bool)
+                dev = None if jax.default_backend() == "tpu" else self._tpu
+                ok = self._kernel.verify_batch(pubs_a, msgs, sigs_a, device=dev)
+            else:
+                ok = self._kernel.verify_batch(
+                    pubs_a, msgs, sigs_a, mesh=self._mesh
+                )
+        ok = np.asarray(ok, dtype=bool)
+        self._warm.add("ed25519")
+        _record_dispatch(self.backend, "ed25519", len(pubs), t0, ok, first=first)
+        return ok
 
     def verify_secp256k1(self, items: Sequence[SigItem]) -> np.ndarray:
         """Batched ECDSA on device. The pallas backend dispatches the fused
@@ -159,21 +203,29 @@ class TPUBatchVerifier:
             return np.zeros((0,), dtype=bool)
         from tendermint_tpu.crypto.hashing import sha256
 
-        pubs = [it.pubkey for it in items]
-        digs = [sha256(it.msg) for it in items]
-        sigs = [it.sig for it in items]
-        if self.backend == "pallas":
-            import jax
+        t0 = time.perf_counter()
+        first = "secp256k1" not in self._warm
+        with trace.span("verify.dispatch", backend=self.backend,
+                        algo="secp256k1", n=len(items)):
+            pubs = [it.pubkey for it in items]
+            digs = [sha256(it.msg) for it in items]
+            sigs = [it.sig for it in items]
+            if self.backend == "pallas":
+                import jax
 
-            from tendermint_tpu.ops import secp256k1_pallas as _skp
+                from tendermint_tpu.ops import secp256k1_pallas as _skp
 
-            dev = None if jax.default_backend() == "tpu" else self._tpu
-            ok = _skp.verify_batch(pubs, digs, sigs, device=dev)
-        else:
-            from tendermint_tpu.ops import secp256k1_verify as _sk
+                dev = None if jax.default_backend() == "tpu" else self._tpu
+                ok = _skp.verify_batch(pubs, digs, sigs, device=dev)
+            else:
+                from tendermint_tpu.ops import secp256k1_verify as _sk
 
-            ok = _sk.verify_batch(pubs, digs, sigs, mesh=self._mesh)
-        return np.asarray(ok, dtype=bool)
+                ok = _sk.verify_batch(pubs, digs, sigs, mesh=self._mesh)
+        ok = np.asarray(ok, dtype=bool)
+        self._warm.add("secp256k1")
+        _record_dispatch(self.backend, "secp256k1", len(items), t0, ok,
+                         first=first)
+        return ok
 
 
 _lock = threading.Lock()
@@ -204,9 +256,18 @@ def get_batch_verifier(prefer_tpu: bool = True):
                     # host C path, so the lazy default only keeps the device
                     # verifier when the fused pipeline is actually reachable
                     # (TM_BATCH_VERIFIER=xla forces the XLA backend instead)
-                    _default = v if v.backend == "pallas" else HostBatchVerifier()
+                    if v.backend == "pallas":
+                        _default = v
+                    else:
+                        _default = HostBatchVerifier()
+                        get_verify_metrics().host_fallback.add(
+                            1.0, ("no_tpu",)
+                        )
                 except Exception:
                     _default = HostBatchVerifier()
+                    get_verify_metrics().host_fallback.add(
+                        1.0, ("device_init_error",)
+                    )
             else:
                 _default = HostBatchVerifier()
         return _default
@@ -278,11 +339,23 @@ def verify_generic(
             if flat is None or len(flat) < pk.k:
                 # structurally invalid / non-ed25519 sub-keys / too few
                 # flagged signers — host path decides (usually False)
+                try:
+                    get_verify_metrics().host_fallback.add(
+                        1.0, ("multisig_structural",)
+                    )
+                except Exception:
+                    pass
                 out[i] = pk.verify_bytes(msgs[i], sigs[i])
                 continue
             ms_groups.append((i, len(ed_items), len(flat)))
             ed_items.extend(SigItem(p, m, s) for p, m, s in flat)
         else:
+            try:
+                get_verify_metrics().host_fallback.add(
+                    1.0, ("unbatchable_key",)
+                )
+            except Exception:
+                pass
             out[i] = pk.verify_bytes(msgs[i], sigs[i])
     if ed_items:
         res = verifier.verify_ed25519(ed_items)

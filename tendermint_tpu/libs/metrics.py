@@ -4,7 +4,14 @@ consensus/metrics.go:14, p2p/metrics.go, mempool/metrics.go,
 state/metrics.go, served at node/node.go:698).
 
 No external client library — exposition format is plain text v0.0.4, which
-is all Prometheus needs to scrape.
+is all Prometheus needs to scrape.  `scripts/metrics_lint.py` holds a strict
+parser for that format and `make metrics-lint` checks every registry this
+module builds against it.
+
+Beyond the four reference families, `VerifyMetrics` covers the TPU-specific
+seams the reference never had: the BatchVerifier boundary (crypto/batch.py),
+the sharded window step (parallel/commit_verify.py), and fast sync's
+speculative double-buffering (blockchain/reactor.py).
 """
 
 from __future__ import annotations
@@ -22,10 +29,25 @@ def _fmt_value(v: float) -> str:
     return repr(v)
 
 
+def _escape_label_value(v: str) -> str:
+    """Text-format v0.0.4 label-value escaping: backslash, double-quote and
+    newline must be escaped or the series line is unparseable/corrupts the
+    scrape (prometheus docs "text-based format", escaping rules)."""
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _escape_help(h: str) -> str:
+    """HELP lines escape backslash and newline (a raw newline would start a
+    bogus sample line mid-scrape)."""
+    return h.replace("\\", "\\\\").replace("\n", "\\n")
+
+
 def _fmt_labels(names: Sequence[str], values: Tuple[str, ...]) -> str:
     if not names:
         return ""
-    inner = ",".join(f'{n}="{v}"' for n, v in zip(names, values))
+    inner = ",".join(
+        f'{n}="{_escape_label_value(v)}"' for n, v in zip(names, values)
+    )
     return "{" + inner + "}"
 
 
@@ -117,44 +139,85 @@ _DEFAULT_BUCKETS = (
     0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0
 )
 
+# power-of-two ladder for batch sizes (1 .. 64k signatures per dispatch)
+_SIZE_BUCKETS = tuple(float(1 << i) for i in range(17))
+
 
 class Histogram(_Metric):
     kind = "histogram"
 
-    def __init__(self, name, help="", buckets: Sequence[float] = _DEFAULT_BUCKETS):
-        super().__init__(name, help)
+    def __init__(self, name, help="", buckets: Sequence[float] = _DEFAULT_BUCKETS,
+                 label_names: Sequence[str] = ()):
+        super().__init__(name, help, label_names)
         self.buckets = tuple(sorted(buckets))
-        self._counts = [0] * (len(self.buckets) + 1)  # +Inf
-        self._sum = 0.0
-        self._count = 0
+        # per-labelset series: labels -> [bucket counts (+Inf last), sum, n]
+        self._series: Dict[Tuple[str, ...], list] = {}
+        if not self.label_names:
+            # an unlabeled histogram exposes its zero series immediately
+            # (back-compat with the pre-labeled exposition)
+            self._series[()] = [[0] * (len(self.buckets) + 1), 0.0, 0]
 
-    def observe(self, v: float) -> None:
+    def labels(self, *values: str) -> "_BoundHistogram":
+        return _BoundHistogram(self, tuple(str(v) for v in values))
+
+    def observe(self, v: float, _labels: Tuple[str, ...] = ()) -> None:
         with self._mtx:
-            self._sum += v
-            self._count += 1
+            s = self._series.get(_labels)
+            if s is None:
+                s = self._series[_labels] = [
+                    [0] * (len(self.buckets) + 1), 0.0, 0
+                ]
+            s[1] += v
+            s[2] += 1
             for i, b in enumerate(self.buckets):
                 if v <= b:
-                    self._counts[i] += 1
+                    s[0][i] += 1
                     return
-            self._counts[-1] += 1
+            s[0][-1] += 1
 
     def expose(self) -> List[str]:
         with self._mtx:
-            counts, total, s = list(self._counts), self._count, self._sum
-        out, cum = [], 0
-        for b, c in zip(self.buckets, counts):
-            cum += c
-            out.append(f'{self.name}_bucket{{le="{b:g}"}} {cum}')
-        out.append(f'{self.name}_bucket{{le="+Inf"}} {total}')
-        out.append(f"{self.name}_sum {_fmt_value(s)}")
-        out.append(f"{self.name}_count {total}")
+            series = [
+                (lv, list(s[0]), s[1], s[2])
+                for lv, s in sorted(self._series.items())
+            ]
+        out: List[str] = []
+        bucket_names = self.label_names + ("le",)
+        for lv, counts, total_sum, n in series:
+            cum = 0
+            for b, c in zip(self.buckets, counts):
+                cum += c
+                out.append(
+                    f"{self.name}_bucket"
+                    f"{_fmt_labels(bucket_names, lv + (f'{b:g}',))} {cum}"
+                )
+            out.append(
+                f"{self.name}_bucket"
+                f"{_fmt_labels(bucket_names, lv + ('+Inf',))} {n}"
+            )
+            out.append(
+                f"{self.name}_sum{_fmt_labels(self.label_names, lv)} "
+                f"{_fmt_value(total_sum)}"
+            )
+            out.append(
+                f"{self.name}_count{_fmt_labels(self.label_names, lv)} {n}"
+            )
         return out
+
+
+class _BoundHistogram:
+    def __init__(self, parent: Histogram, labels: Tuple[str, ...]):
+        self._p, self._l = parent, labels
+
+    def observe(self, v: float) -> None:
+        self._p.observe(v, self._l)
 
 
 class Registry:
     def __init__(self, namespace: str = "tendermint"):
         self.namespace = namespace
         self._metrics: List[_Metric] = []
+        self._attached: List["Registry"] = []
         self._mtx = threading.Lock()
 
     def _register(self, m: _Metric) -> _Metric:
@@ -163,33 +226,135 @@ class Registry:
         return m
 
     def counter(self, name, help="", label_names=()) -> Counter:
-        return self._register(Counter(f"{self.namespace}_{name}", help, label_names))
+        return self._register(
+            Counter(f"{self.namespace}_{name}", help, label_names)
+        )
 
     def gauge(self, name, help="", label_names=()) -> Gauge:
         return self._register(Gauge(f"{self.namespace}_{name}", help, label_names))
 
-    def histogram(self, name, help="", buckets=_DEFAULT_BUCKETS) -> Histogram:
-        return self._register(Histogram(f"{self.namespace}_{name}", help, buckets))
+    def histogram(self, name, help="", buckets=_DEFAULT_BUCKETS,
+                  label_names=()) -> Histogram:
+        return self._register(
+            Histogram(f"{self.namespace}_{name}", help, buckets, label_names)
+        )
+
+    def attach(self, other: "Registry") -> None:
+        """Expose another registry's metrics through this one's scrape.
+        The process-wide VerifyMetrics registry rides every node's /metrics
+        this way (the batch verifier is process-global, so per-node
+        registration would double count)."""
+        with self._mtx:
+            if other is not self and other not in self._attached:
+                self._attached.append(other)
 
     def expose_text(self) -> str:
         lines: List[str] = []
         with self._mtx:
             metrics = list(self._metrics)
+            attached = list(self._attached)
         for m in metrics:
             if m.help:
-                lines.append(f"# HELP {m.name} {m.help}")
+                lines.append(f"# HELP {m.name} {_escape_help(m.help)}")
             lines.append(f"# TYPE {m.name} {m.kind}")
             lines.extend(m.expose())
-        return "\n".join(lines) + "\n"
+        text = "\n".join(lines) + "\n" if lines else ""
+        for reg in attached:
+            text += reg.expose_text()
+        return text
 
 
 # -- the per-subsystem metric sets the reference defines -----------------------
 
 
+class VerifyMetrics:
+    """Verification-pipeline telemetry — the TPU batch boundary.
+
+    Recorded inside crypto/batch.py (every BatchVerifier dispatch),
+    parallel/commit_verify.py (the sharded window step) and
+    blockchain/reactor.py (fast sync's speculative double-buffering).
+    Labels stay low-cardinality: backend in {host, xla, pallas, window,
+    window_mesh}, algo in {ed25519, secp256k1}.
+    """
+
+    def __init__(self, registry: Optional[Registry] = None):
+        r = registry or Registry()
+        self.registry = r
+        self.batch_size = r.histogram(
+            "verify_batch_size", "Signatures per batch-verify dispatch",
+            buckets=_SIZE_BUCKETS,
+        )
+        self.dispatch_seconds = r.histogram(
+            "verify_dispatch_seconds",
+            "Batch-verify dispatch wall seconds by backend",
+            label_names=("backend",),
+        )
+        self.compile_seconds = r.histogram(
+            "verify_compile_seconds",
+            "First-dispatch (compile/warm-up) wall seconds by backend",
+            buckets=(0.01, 0.1, 0.5, 1.0, 5.0, 15.0, 60.0, 300.0),
+            label_names=("backend",),
+        )
+        self.calls = r.counter(
+            "verify_calls_total", "Batch-verify dispatches",
+            label_names=("backend", "algo"),
+        )
+        self.sigs = r.counter(
+            "verify_sigs_total", "Signatures verified in batch dispatches",
+            label_names=("backend", "algo"),
+        )
+        self.rejects = r.counter(
+            "verify_rejects_total", "Signatures that failed verification",
+            label_names=("backend", "algo"),
+        )
+        self.host_fallback = r.counter(
+            "verify_host_fallback_total",
+            "Items diverted from the device batch to the host path",
+            label_names=("reason",),
+        )
+        self.speculative = r.counter(
+            "verify_speculative_total",
+            "Speculative (double-buffered) fast-sync window verifies by outcome",
+            label_names=("outcome",),
+        )
+        self.window_heights = r.histogram(
+            "verify_window_heights", "Heights per fast-sync verify window",
+            buckets=tuple(float(1 << i) for i in range(11)),
+        )
+
+    def record_dispatch(self, backend: str, algo: str, n: int,
+                        seconds: float, rejects: int = 0,
+                        first: bool = False) -> None:
+        """One batch dispatch: size + latency + outcome in one call so the
+        instrumented hot paths stay one-liners."""
+        self.batch_size.observe(float(n))
+        self.dispatch_seconds.observe(seconds, (backend,))
+        if first:
+            self.compile_seconds.observe(seconds, (backend,))
+        self.calls.add(1.0, (backend, algo))
+        self.sigs.add(float(n), (backend, algo))
+        if rejects:
+            self.rejects.add(float(rejects), (backend, algo))
+
+
+_verify_mtx = threading.Lock()
+_verify_metrics: Optional[VerifyMetrics] = None
+
+
+def get_verify_metrics() -> VerifyMetrics:
+    """Process-wide VerifyMetrics singleton — mirrors the process-wide
+    default BatchVerifier (crypto/batch.get_batch_verifier)."""
+    global _verify_metrics
+    with _verify_mtx:
+        if _verify_metrics is None:
+            _verify_metrics = VerifyMetrics()
+        return _verify_metrics
+
+
 class NodeMetrics:
     """All four reference metric families on one registry
     (consensus/metrics.go:14, p2p/metrics.go, mempool/metrics.go,
-    state/metrics.go)."""
+    state/metrics.go), plus the process-wide verify family attached."""
 
     def __init__(self, registry: Optional[Registry] = None):
         r = registry or Registry()
@@ -225,6 +390,9 @@ class NodeMetrics:
             "state_block_processing_time", "ApplyBlock seconds",
             buckets=[b / 10 for b in _DEFAULT_BUCKETS],
         )
+        # verify pipeline (process-global; attached, not re-registered)
+        self.verify = get_verify_metrics()
+        r.attach(self.verify.registry)
         self._last_block_time: Optional[float] = None
 
     # called from the consensus event path -------------------------------------
@@ -237,12 +405,27 @@ class NodeMetrics:
         if valset is not None:
             self.validators.set(valset.size)
             self.validators_power.set(valset.total_voting_power())
-            missing = sum(1 for pc in block.last_commit.precommits if pc is None)
             if block.height > 1:
+                # height 1 has no LastCommit — counting "missing" precommits
+                # there reports the whole valset absent
+                missing = sum(
+                    1 for pc in block.last_commit.precommits if pc is None
+                )
                 self.missing_validators.set(missing)
         # double-sign evidence included in this block (metrics.go
         # ByzantineValidators is computed from block evidence)
         self.byzantine_validators.set(len(block.evidence.evidence))
         if self._last_block_time is not None:
-            self.block_interval_seconds.observe(now - self._last_block_time)
+            dt = now - self._last_block_time
+            # monotonic() is process-local: a restart (or a timer reset at
+            # fast-sync exit) leaves no usable previous timestamp, and a
+            # non-positive delta means the clock basis changed under us
+            if dt > 0:
+                self.block_interval_seconds.observe(dt)
         self._last_block_time = now
+
+    def reset_block_timer(self) -> None:
+        """Forget the last block timestamp.  Called at fast-sync exit: the
+        synced blocks arrived at replay speed, so the next live block's
+        interval measured against them would be garbage."""
+        self._last_block_time = None

@@ -16,6 +16,7 @@ from typing import Callable, Dict, List, Optional
 
 from tendermint_tpu.abci import types as abci
 from tendermint_tpu.crypto.hashing import tmhash
+from tendermint_tpu.libs import trace
 from tendermint_tpu.libs.clist import CElement, CList
 from tendermint_tpu.state.services import Mempool as MempoolIface
 
@@ -260,10 +261,11 @@ class Mempool(MempoolIface):
             self._notify_txs_available()
 
     def _recheck_txs(self) -> None:
-        self._recheck_cursor = self._txs.front()
-        self._recheck_end = self._txs.back()
-        self._rechecking = True
-        for memtx in self._txs:
-            self._proxy.check_tx_async(memtx.tx)
-        self._proxy.flush_async()
+        with trace.span("mempool.recheck", n=self.size()):
+            self._recheck_cursor = self._txs.front()
+            self._recheck_end = self._txs.back()
+            self._rechecking = True
+            for memtx in self._txs:
+                self._proxy.check_tx_async(memtx.tx)
+            self._proxy.flush_async()
         self._notify_txs_available()

@@ -237,6 +237,7 @@ class Node(BaseService):
             self.block_store,
             fast_sync=fast_sync,
             consensus_reactor=self.consensus_reactor,
+            metrics=self.metrics,
         )
         mem_reactor = MempoolReactor(
             self.mempool,

@@ -20,6 +20,7 @@ nil votes are a ``present`` mask so the quorum math stays branch-free.
 
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass
 from typing import Optional, Sequence, Tuple
 
@@ -28,6 +29,8 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from tendermint_tpu.libs import trace
+from tendermint_tpu.libs.metrics import get_verify_metrics
 from tendermint_tpu.ops import ed25519_verify as _k
 
 SigTuple = Tuple[bytes, bytes, bytes]  # (pubkey32, msg, sig64)
@@ -173,15 +176,32 @@ def verify_commit_window(
     # silently canonicalizes them to int32 and the quorum tally wraps — a
     # consensus-safety bug.  Scope the flag to this dispatch instead of
     # flipping global dtype semantics for the whole process at import time.
-    with jax.enable_x64(True):
-        if mesh is not None:
-            from jax.sharding import NamedSharding, PartitionSpec as PS
+    backend = "window_mesh" if mesh is not None else "window"
+    first = mesh not in _step_cache
+    n = int(np.count_nonzero(win.present))
+    t0 = time.perf_counter()
+    with trace.span("verify.window_dispatch", backend=backend, H=H, V=V, n=n):
+        with jax.enable_x64(True):
+            if mesh is not None:
+                from jax.sharding import NamedSharding, PartitionSpec as PS
 
-            hv = NamedSharding(mesh, PS(*mesh.axis_names[:2]))
-            arrs = [jax.device_put(a, hv) for a in arrs]
-        ok, tally, committed = _compiled_step(mesh)(*arrs, np.int64(total_power))
+                hv = NamedSharding(mesh, PS(*mesh.axis_names[:2]))
+                arrs = [jax.device_put(a, hv) for a in arrs]
+            ok, tally, committed = _compiled_step(mesh)(
+                *arrs, np.int64(total_power)
+            )
+            ok = np.asarray(ok)[:H, :V]
+    try:
+        # rejects = votes that passed host prechecks but failed the device
+        # verify; first dispatch per mesh key carries the jit compile
+        get_verify_metrics().record_dispatch(
+            backend, "ed25519", n, time.perf_counter() - t0,
+            rejects=int(np.count_nonzero(win.present & ~ok)), first=first,
+        )
+    except Exception:
+        pass
     return (
-        np.asarray(ok)[:H, :V],
+        ok,
         np.asarray(tally)[:H],
         np.asarray(committed)[:H],
     )

@@ -480,6 +480,39 @@ class RPCEnv:
         self.node.mempool.flush()
         return {}
 
+    def dump_trace(self) -> dict:
+        """Snapshot the span-tracer ring as Chrome trace-event JSON (load at
+        chrome://tracing or ui.perfetto.dev).  Gated like the unsafe_*
+        routes — the dump leaks internal timings and thread names."""
+        self._require_unsafe()
+        from tendermint_tpu.libs import trace
+
+        out = trace.chrome_trace()
+        out["enabled"] = trace.enabled()
+        out["dropped"] = trace.dropped()
+        return out
+
+    def trace_reset(self, enable=None, capacity=None) -> dict:
+        """Clear the span-tracer ring; optionally flip the tracer on/off
+        (enable=true/false) and resize the ring (capacity=N)."""
+        self._require_unsafe()
+        from tendermint_tpu.libs import trace
+
+        if capacity is not None:
+            capacity = int(capacity)
+            if capacity < 1:
+                raise RPCError(-32602, "capacity must be >= 1")
+        trace.reset(capacity)
+        if enable is not None:
+            if bool(enable):
+                trace.enable()
+            else:
+                trace.disable()
+        return {
+            "enabled": trace.enabled(),
+            "capacity": trace.get_tracer().capacity,
+        }
+
     def unsafe_dump_threads(self) -> dict:
         """Stack dump of every live thread — the pprof-goroutine analogue
         (ref: pprof server at node/node.go:474-479)."""

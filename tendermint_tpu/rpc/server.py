@@ -14,6 +14,7 @@ import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
+from tendermint_tpu.libs import trace
 from tendermint_tpu.libs.service import BaseService
 from tendermint_tpu.rpc.core.env import RPCEnv, RPCError
 
@@ -61,7 +62,8 @@ class RPCServer(BaseService):
                         _err(req_id, -32601, f"method {method!r} not found")
                     )
                 try:
-                    result = fn(**params)
+                    with trace.span("rpc.dispatch", method=method):
+                        result = fn(**params)
                     self._send({"jsonrpc": "2.0", "id": req_id, "result": result})
                 except RPCError as e:
                     self._send(_err(req_id, e.code, e.message))
@@ -93,8 +95,14 @@ class RPCServer(BaseService):
                 if method == "metrics":
                     reg = getattr(env.node, "metrics", None)
                     if reg is None:
-                        return self._send(_err(None, -32601, "metrics disabled"), 404)
-                    body = reg.registry.expose_text().encode()
+                        # 200 + comment, not 404: scrapers must be able to
+                        # tell "instrumentation off" from "no such route"
+                        body = (
+                            b"# metrics disabled "
+                            b"(instrumentation.prometheus = false)\n"
+                        )
+                    else:
+                        body = reg.registry.expose_text().encode()
                     self.send_response(200)
                     self.send_header("Content-Type", "text/plain; version=0.0.4")
                     self.send_header("Content-Length", str(len(body)))

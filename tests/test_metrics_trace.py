@@ -1,0 +1,371 @@
+"""Unit tests for the observability layer: labeled Histograms + exposition
+escaping (libs/metrics.py), the ring-buffer span tracer (libs/trace.py), and
+the strict text-format v0.0.4 linter (scripts/metrics_lint.py).
+"""
+
+import importlib.util
+import json
+import os
+import threading
+
+import pytest
+
+from tendermint_tpu.libs import trace as trace_mod
+from tendermint_tpu.libs.metrics import (
+    Histogram,
+    NodeMetrics,
+    Registry,
+    VerifyMetrics,
+    _escape_label_value,
+    _fmt_labels,
+)
+from tendermint_tpu.libs.trace import Tracer, _NOOP
+
+
+def _load_metrics_lint():
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "scripts", "metrics_lint.py",
+    )
+    spec = importlib.util.spec_from_file_location("metrics_lint", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# -- labeled Histogram --------------------------------------------------------------
+
+
+class TestLabeledHistogram:
+    def test_per_labelset_series(self):
+        h = Histogram("h", buckets=(1.0, 10.0), label_names=("backend",))
+        h.observe(0.5, ("host",))
+        h.observe(5.0, ("host",))
+        h.observe(100.0, ("pallas",))
+        lines = h.expose()
+        assert 'h_bucket{backend="host",le="1"} 1' in lines
+        assert 'h_bucket{backend="host",le="10"} 2' in lines
+        assert 'h_bucket{backend="host",le="+Inf"} 2' in lines
+        assert 'h_count{backend="host"} 2' in lines
+        assert 'h_sum{backend="host"} 5.5' in lines
+        assert 'h_bucket{backend="pallas",le="10"} 0' in lines
+        assert 'h_bucket{backend="pallas",le="+Inf"} 1' in lines
+
+    def test_bound_labels_helper(self):
+        h = Histogram("h", buckets=(1.0,), label_names=("b",))
+        h.labels("xla").observe(0.2)
+        assert 'h_bucket{b="xla",le="1"} 1' in h.expose()
+
+    def test_unlabeled_exposes_zero_series(self):
+        h = Histogram("h", buckets=(1.0,))
+        lines = h.expose()
+        assert 'h_bucket{le="1"} 0' in lines
+        assert "h_count 0" in lines
+
+    def test_buckets_cumulative(self):
+        h = Histogram("h", buckets=(1.0, 2.0, 3.0))
+        for v in (0.5, 1.5, 1.7, 2.5, 9.0):
+            h.observe(v)
+        lines = h.expose()
+        assert 'h_bucket{le="1"} 1' in lines
+        assert 'h_bucket{le="2"} 3' in lines
+        assert 'h_bucket{le="3"} 4' in lines
+        assert 'h_bucket{le="+Inf"} 5' in lines
+
+    def test_registry_labeled_histogram(self):
+        r = Registry()
+        h = r.histogram("lat", "latency", buckets=(1.0,), label_names=("x",))
+        h.observe(0.1, ("a",))
+        text = r.expose_text()
+        assert "# TYPE tendermint_lat histogram" in text
+        assert 'tendermint_lat_bucket{x="a",le="1"} 1' in text
+
+
+# -- exposition escaping ------------------------------------------------------------
+
+
+class TestExpositionEscaping:
+    def test_label_value_escapes(self):
+        assert _escape_label_value('a\\b"c\nd') == 'a\\\\b\\"c\\nd'
+
+    def test_fmt_labels_escapes(self):
+        out = _fmt_labels(("p",), ('C:\\x\n"q"',))
+        assert out == '{p="C:\\\\x\\n\\"q\\""}'
+
+    def test_counter_label_roundtrip_single_line(self):
+        r = Registry()
+        c = r.counter("evil", "", label_names=("v",))
+        c.add(1.0, ('multi\nline "quoted" \\slash',))
+        text = r.expose_text()
+        # the escaped series must stay on ONE line
+        lines = [l for l in text.splitlines() if l.startswith("tendermint_evil")]
+        assert len(lines) == 1
+        assert '\\n' in lines[0] and '\\"' in lines[0] and "\\\\" in lines[0]
+
+    def test_help_newline_escaped(self):
+        r = Registry()
+        r.counter("c", "first line\nsecond line")
+        text = r.expose_text()
+        help_line = next(l for l in text.splitlines() if l.startswith("# HELP"))
+        assert help_line == "# HELP tendermint_c first line\\nsecond line"
+
+    def test_linted_clean(self):
+        lint = _load_metrics_lint()
+        r = Registry()
+        c = r.counter("c", 'help \\ with\nnewline', label_names=("l",))
+        c.add(2.0, ('x\\y\n"z"',))
+        h = r.histogram("h", "hh", buckets=(1.0,), label_names=("b",))
+        h.observe(0.5, ("k\\v",))
+        assert lint.lint_text(r.expose_text()) == []
+
+
+# -- NodeMetrics.record_block guards ------------------------------------------------
+
+
+class _FakeBlock:
+    def __init__(self, height, n_missing=0):
+        from types import SimpleNamespace
+
+        self.height = height
+        self.data = SimpleNamespace(txs=[b"t1", b"t2"])
+        self.evidence = SimpleNamespace(evidence=[])
+        self.last_commit = SimpleNamespace(
+            precommits=[None] * n_missing + ["sig"] * (3 - n_missing)
+        )
+
+    def marshal(self):
+        return b"x" * 100
+
+
+class _FakeValset:
+    size = 3
+
+    def total_voting_power(self):
+        return 30
+
+
+class TestRecordBlockGuards:
+    def test_height1_does_not_publish_missing(self):
+        m = NodeMetrics()
+        # height-1 blocks have no real LastCommit; a full "missing" valset
+        # must not be published
+        m.record_block(_FakeBlock(1, n_missing=3), _FakeValset())
+        assert "tendermint_consensus_missing_validators 0" in (
+            m.registry.expose_text()
+        )
+
+    def test_height2_publishes_missing(self):
+        m = NodeMetrics()
+        m.record_block(_FakeBlock(2, n_missing=2), _FakeValset())
+        assert "tendermint_consensus_missing_validators 2" in (
+            m.registry.expose_text()
+        )
+
+    def test_reset_block_timer_skips_interval(self):
+        m = NodeMetrics()
+        m.record_block(_FakeBlock(2), _FakeValset())
+        m.reset_block_timer()
+        m.record_block(_FakeBlock(3), _FakeValset())
+        # only after TWO post-reset observations does an interval exist
+        text = m.registry.expose_text()
+        assert "tendermint_consensus_block_interval_seconds_count 0" in text
+        m.record_block(_FakeBlock(4), _FakeValset())
+        text = m.registry.expose_text()
+        assert "tendermint_consensus_block_interval_seconds_count 1" in text
+
+
+# -- VerifyMetrics ------------------------------------------------------------------
+
+
+class TestVerifyMetrics:
+    def test_record_dispatch(self):
+        vm = VerifyMetrics()
+        vm.record_dispatch("host", "ed25519", 64, 0.012, rejects=3, first=True)
+        vm.record_dispatch("host", "ed25519", 128, 0.002)
+        text = vm.registry.expose_text()
+        assert 'tendermint_verify_calls_total{backend="host",algo="ed25519"} 2' in text
+        assert 'tendermint_verify_sigs_total{backend="host",algo="ed25519"} 192' in text
+        assert 'tendermint_verify_rejects_total{backend="host",algo="ed25519"} 3' in text
+        assert 'tendermint_verify_compile_seconds_count{backend="host"} 1' in text
+        assert 'tendermint_verify_dispatch_seconds_count{backend="host"} 2' in text
+        assert "tendermint_verify_batch_size_count 2" in text
+
+    def test_host_verifier_records(self):
+        from tendermint_tpu.crypto import ed25519 as ed
+        from tendermint_tpu.crypto.batch import HostBatchVerifier, SigItem
+        from tendermint_tpu.libs.metrics import get_verify_metrics
+
+        vm = get_verify_metrics()
+        before = vm.calls._values.get(("host", "ed25519"), 0.0)
+        priv = ed.gen_privkey(b"\x07" * 32)
+        msg = b"metrics-e2e"
+        item = SigItem(priv[32:], msg, ed.sign(priv, msg))
+        ok = HostBatchVerifier().verify_ed25519([item])
+        assert bool(ok[0])
+        assert vm.calls._values.get(("host", "ed25519"), 0.0) == before + 1
+
+    def test_node_metrics_attaches_verify_family(self):
+        m = NodeMetrics()
+        text = m.registry.expose_text()
+        assert "tendermint_verify_batch_size_bucket" in text
+        assert "# TYPE tendermint_verify_dispatch_seconds histogram" in text
+
+
+# -- span tracer --------------------------------------------------------------------
+
+
+class TestTracer:
+    def test_disabled_is_noop_singleton(self):
+        t = Tracer(capacity=4)
+        assert t.span("x", a=1) is _NOOP
+        t.instant("y")
+        assert len(t) == 0
+
+    def test_span_records(self):
+        t = Tracer(capacity=8)
+        t.enable()
+        with t.span("fastsync.window", h0=5, n=3):
+            pass
+        t.instant("consensus.step", height=1)
+        assert len(t) == 2
+        events = t.export()
+        by_name = {e["name"]: e for e in events if e.get("ph") != "M"}
+        win = by_name["fastsync.window"]
+        assert win["ph"] == "X" and win["dur"] >= 0
+        assert win["cat"] == "fastsync"
+        assert win["args"] == {"h0": 5, "n": 3}
+        step = by_name["consensus.step"]
+        assert step["ph"] == "i" and step["s"] == "t"
+
+    def test_ring_wraparound_keeps_newest(self):
+        t = Tracer(capacity=4)
+        t.enable()
+        for i in range(10):
+            t.instant("e", i=i)
+        assert len(t) == 4
+        assert t.dropped() == 6
+        events = [e for e in t.export() if e.get("ph") != "M"]
+        assert [e["args"]["i"] for e in events] == [6, 7, 8, 9]
+
+    def test_reset_clears(self):
+        t = Tracer(capacity=4)
+        t.enable()
+        t.instant("e")
+        t.reset()
+        assert len(t) == 0 and t.dropped() == 0
+        assert t.enabled  # reset does not flip the switch
+
+    def test_reset_resizes(self):
+        t = Tracer(capacity=4)
+        t.enable(capacity=16)
+        assert t.capacity == 16
+        t.reset(capacity=2)
+        assert t.capacity == 2
+        for i in range(5):
+            t.instant("e", i=i)
+        assert len(t) == 2
+
+    def test_thread_safety(self):
+        t = Tracer(capacity=1 << 14)
+        t.enable()
+        N, THREADS = 500, 8
+
+        def work(k):
+            for i in range(N):
+                with t.span("w", k=k, i=i):
+                    pass
+
+        threads = [threading.Thread(target=work, args=(k,)) for k in range(THREADS)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        assert len(t) == N * THREADS
+        assert t.dropped() == 0
+        events = [e for e in t.export() if e.get("ph") != "M"]
+        assert len(events) == N * THREADS
+        # every (k, i) recorded exactly once
+        seen = {(e["args"]["k"], e["args"]["i"]) for e in events}
+        assert len(seen) == N * THREADS
+
+    def test_chrome_trace_shape_and_json(self):
+        t = Tracer(capacity=8)
+        t.enable()
+        with t.span("rpc.dispatch", method="status"):
+            pass
+        doc = t.chrome_trace()
+        # round-trips through JSON (what the dump_trace RPC returns)
+        doc2 = json.loads(json.dumps(doc))
+        assert doc2["displayTimeUnit"] == "ms"
+        evs = doc2["traceEvents"]
+        assert any(e["ph"] == "M" and e["name"] == "thread_name" for e in evs)
+        x = next(e for e in evs if e.get("ph") == "X")
+        assert {"name", "cat", "pid", "tid", "ts", "dur"} <= set(x)
+
+    def test_capacity_validation(self):
+        with pytest.raises(ValueError):
+            Tracer(capacity=0)
+        t = Tracer(capacity=1)
+        with pytest.raises(ValueError):
+            t.enable(capacity=-3)
+
+    def test_module_level_disabled_by_default(self):
+        # TM_TRACE unset in the test env: the module tracer must be the
+        # zero-alloc path
+        assert trace_mod.span("x") is _NOOP
+
+
+# -- strict linter ------------------------------------------------------------------
+
+
+class TestMetricsLint:
+    @pytest.fixture(scope="class")
+    def lint(self):
+        return _load_metrics_lint()
+
+    def test_self_check_clean(self, lint):
+        assert lint._self_check() == []
+
+    def test_catches_unescaped_quote(self, lint):
+        bad = 'm{l="a"b"} 1\n'
+        assert lint.lint_text(bad)
+
+    def test_catches_duplicate_series(self, lint):
+        bad = 'm{l="a"} 1\nm{l="a"} 2\n'
+        errs = lint.lint_text(bad)
+        assert any("duplicate series" in e for e in errs)
+
+    def test_catches_bad_escape(self, lint):
+        bad = 'm{l="a\\t"} 1\n'
+        errs = lint.lint_text(bad)
+        assert any("illegal escape" in e for e in errs)
+
+    def test_catches_noncumulative_histogram(self, lint):
+        bad = (
+            "# TYPE h histogram\n"
+            'h_bucket{le="1"} 5\nh_bucket{le="2"} 3\nh_bucket{le="+Inf"} 5\n'
+            "h_sum 1\nh_count 5\n"
+        )
+        errs = lint.lint_text(bad)
+        assert any("not cumulative" in e for e in errs)
+
+    def test_catches_missing_inf_bucket(self, lint):
+        bad = "# TYPE h histogram\n" 'h_bucket{le="1"} 5\nh_sum 1\nh_count 5\n'
+        errs = lint.lint_text(bad)
+        assert any("+Inf" in e for e in errs)
+
+    def test_catches_count_mismatch(self, lint):
+        bad = (
+            "# TYPE h histogram\n"
+            'h_bucket{le="+Inf"} 5\nh_sum 1\nh_count 7\n'
+        )
+        errs = lint.lint_text(bad)
+        assert any("_count" in e for e in errs)
+
+    def test_catches_bad_value(self, lint):
+        assert lint.lint_text("m not_a_number\n")
+
+    def test_accepts_live_registry(self, lint):
+        m = NodeMetrics()
+        m.record_block(_FakeBlock(2), _FakeValset())
+        assert lint.lint_text(m.registry.expose_text()) == []

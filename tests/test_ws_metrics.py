@@ -83,7 +83,8 @@ def live_node(tmp_path):
     cfg.rpc.laddr = "tcp://127.0.0.1:0"
     cfg.p2p.laddr = ""
     cfg.consensus = test_config().consensus
-    cfg.consensus.wal_path = ""
+    # real WAL: the trace-export test asserts wal.fsync spans show up e2e
+    cfg.consensus.wal_path = "data/cs.wal/wal"
     cfg.instrumentation.prometheus = True
     cfg.rpc.unsafe = True
     os.makedirs(os.path.join(home, "config"), exist_ok=True)
@@ -202,6 +203,12 @@ class TestPrometheusMetrics:
             "tendermint_mempool_size",
             "tendermint_state_block_processing_time_count",
             "tendermint_consensus_block_interval_seconds_bucket",
+            # verify pipeline: height-2+ commits batch through the process
+            # verifier, so the attached tendermint_verify_* family has data
+            "# TYPE tendermint_verify_batch_size histogram",
+            "tendermint_verify_batch_size_bucket",
+            'tendermint_verify_dispatch_seconds_bucket{backend="host"',
+            'tendermint_verify_calls_total{backend="host",algo="ed25519"}',
         ):
             assert needle in text, f"missing {needle}\n{text[:1500]}"
         # height gauge tracks the chain
@@ -210,6 +217,24 @@ class TestPrometheusMetrics:
             if l.startswith("tendermint_consensus_height ")
         )
         assert float(height_line.split()[-1]) >= 1
+        # the host verifier has recorded at least one commit's signatures
+        calls_line = next(
+            l for l in text.splitlines()
+            if l.startswith('tendermint_verify_calls_total{backend="host"')
+        )
+        assert float(calls_line.split()[-1]) >= 1
+
+    def test_metrics_route_200_when_disabled(self, live_node):
+        """Scrapers must distinguish 'instrumentation off' (200 + comment)
+        from 'no such route' (404)."""
+        saved = live_node.metrics
+        live_node.metrics = None
+        try:
+            status, body = _rpc_get(live_node, "/metrics")
+            assert status == 200
+            assert body.startswith(b"# metrics disabled")
+        finally:
+            live_node.metrics = saved
 
 
 class TestDebugRoutes:
@@ -230,5 +255,55 @@ class TestDebugRoutes:
             import json as _json
 
             assert "error" in _json.loads(body)
+        finally:
+            live_node.config.rpc.unsafe = True
+
+
+class TestTraceExport:
+    def test_trace_reset_and_dump(self, live_node):
+        """Enable the tracer over RPC, let consensus commit a block, and pull
+        a Chrome trace with consensus-step and WAL-fsync spans."""
+        from tendermint_tpu.libs import trace
+
+        h0 = live_node.block_store.height()
+        _, body = _rpc_get(live_node, "/trace_reset?enable=true")
+        try:
+            res = json.loads(body)["result"]
+            assert res["enabled"] is True
+            # a fresh commit must land while tracing
+            assert wait_for(
+                lambda: live_node.block_store.height() >= h0 + 1, timeout=30
+            )
+            status, body = _rpc_get(live_node, "/dump_trace")
+            assert status == 200
+            doc = json.loads(body)["result"]
+            assert doc["displayTimeUnit"] == "ms"
+            events = doc["traceEvents"]
+            names = {e["name"] for e in events}
+            assert "consensus.step" in names
+            assert "wal.fsync" in names
+            assert "thread_name" in names  # metadata events
+            # every event is well-formed Chrome trace JSON
+            for e in events:
+                assert e["ph"] in ("X", "i", "M")
+                if e["ph"] == "X":
+                    assert e["dur"] >= 0 and "ts" in e
+                if e["ph"] == "i":
+                    assert e["s"] == "t"
+            step = next(e for e in events if e["name"] == "consensus.step")
+            assert step["args"]["height"] >= 1
+        finally:
+            trace.disable()
+            trace.reset()
+
+    def test_trace_routes_gated(self, live_node):
+        from tendermint_tpu.libs import trace
+
+        live_node.config.rpc.unsafe = False
+        try:
+            for route in ("/dump_trace", "/trace_reset"):
+                _, body = _rpc_get(live_node, route)
+                assert "error" in json.loads(body)
+            assert not trace.enabled()
         finally:
             live_node.config.rpc.unsafe = True
