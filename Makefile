@@ -3,8 +3,8 @@
 PYTHON ?= python
 
 .PHONY: test test_slow test_sanitizers bench bench-local bench_fastsync \
-        bench_secp bench_multisig metrics-lint localnet-start localnet-stop \
-        build-docker-localnode
+        bench_secp bench_multisig metrics-lint statesync-smoke \
+        localnet-start localnet-stop build-docker-localnode
 
 test:
 	$(PYTHON) -m pytest tests/ -q
@@ -37,6 +37,11 @@ bench_multisig:
 # to lint scrape snapshots: make metrics-lint ARGS="/tmp/m.prom"
 metrics-lint:
 	JAX_PLATFORMS=cpu $(PYTHON) scripts/metrics_lint.py $(ARGS)
+
+# in-process snapshot restore (producer -> chunk fetch -> light-client verify
+# -> batched backfill) + linted tendermint_statesync_* scrape
+statesync-smoke:
+	JAX_PLATFORMS=cpu $(PYTHON) scripts/statesync_smoke.py
 
 build-docker-localnode:
 	docker build -t tendermint_tpu/localnode networks/local/localnode

@@ -102,6 +102,13 @@ class PersistentKVStoreApp(KVStoreApp):
         self._db = db or MemDB()
         self._val_updates: List[abci.ValidatorUpdate] = []
         self.validators: Dict[bytes, int] = {}  # raw pubkey -> power
+        # state-sync snapshots (off until configure_snapshots)
+        self._snapshot_store = None
+        self._snapshot_interval = 0
+        self._snapshot_chunk_size = 65536
+        self._snapshot_keep_recent = 3
+        # restore in progress: (Snapshot, expected chunk hashes, chunks so far)
+        self._restoring: Optional[tuple] = None
         self._load()
 
     def _load(self) -> None:
@@ -167,7 +174,121 @@ class PersistentKVStoreApp(KVStoreApp):
     def commit(self, req: abci.RequestCommit) -> abci.ResponseCommit:
         res = super().commit(req)
         self._save()
+        self._maybe_snapshot()
         return res
+
+    # -- state-sync snapshots ------------------------------------------------
+    def configure_snapshots(
+        self, store, interval: int, chunk_size: int = 65536,
+        keep_recent: int = 3,
+    ) -> None:
+        """Enable snapshot production: every `interval` heights, chunk the
+        persisted state blob into `store` (a statesync.SnapshotStore)."""
+        self._snapshot_store = store
+        self._snapshot_interval = interval
+        self._snapshot_chunk_size = chunk_size
+        self._snapshot_keep_recent = keep_recent
+
+    def _state_blob(self) -> bytes:
+        # the exact bytes _save persists — a restore round-trips through
+        # _load, so snapshot and disk formats can never drift apart
+        return self._db.get(b"kvstore:state") or b"{}"
+
+    def _maybe_snapshot(self) -> None:
+        if (
+            self._snapshot_store is None
+            or self._snapshot_interval <= 0
+            or self.height % self._snapshot_interval != 0
+        ):
+            return
+        from tendermint_tpu.statesync.chunker import make_snapshot
+
+        snap, chunks = make_snapshot(
+            self.height, self._state_blob(), self._snapshot_chunk_size
+        )
+        self._snapshot_store.save(snap, chunks)
+        self._snapshot_store.prune(self._snapshot_keep_recent)
+
+    def list_snapshots(
+        self, req: abci.RequestListSnapshots
+    ) -> abci.ResponseListSnapshots:
+        if self._snapshot_store is None:
+            return abci.ResponseListSnapshots()
+        return abci.ResponseListSnapshots(snapshots=self._snapshot_store.list())
+
+    def offer_snapshot(
+        self, req: abci.RequestOfferSnapshot
+    ) -> abci.ResponseOfferSnapshot:
+        from tendermint_tpu.statesync.chunker import (
+            SNAPSHOT_FORMAT,
+            chunk_hashes_from_metadata,
+        )
+
+        snap = req.snapshot
+        if snap is None or snap.height <= 0:
+            return abci.ResponseOfferSnapshot(result=abci.OFFER_SNAPSHOT_REJECT)
+        if snap.format != SNAPSHOT_FORMAT:
+            return abci.ResponseOfferSnapshot(
+                result=abci.OFFER_SNAPSHOT_REJECT_FORMAT
+            )
+        try:
+            hashes = chunk_hashes_from_metadata(snap)
+        except ValueError:
+            return abci.ResponseOfferSnapshot(result=abci.OFFER_SNAPSHOT_REJECT)
+        self._restoring = (snap, hashes, [])
+        return abci.ResponseOfferSnapshot(result=abci.OFFER_SNAPSHOT_ACCEPT)
+
+    def load_snapshot_chunk(
+        self, req: abci.RequestLoadSnapshotChunk
+    ) -> abci.ResponseLoadSnapshotChunk:
+        if self._snapshot_store is None:
+            return abci.ResponseLoadSnapshotChunk()
+        chunk = self._snapshot_store.load_chunk(
+            req.height, req.format, req.chunk
+        )
+        return abci.ResponseLoadSnapshotChunk(chunk=chunk or b"")
+
+    def apply_snapshot_chunk(
+        self, req: abci.RequestApplySnapshotChunk
+    ) -> abci.ResponseApplySnapshotChunk:
+        from tendermint_tpu.crypto import merkle
+
+        if self._restoring is None:
+            return abci.ResponseApplySnapshotChunk(
+                result=abci.APPLY_CHUNK_ABORT
+            )
+        snap, hashes, chunks = self._restoring
+        if req.index != len(chunks):
+            # chunks apply strictly in order for this format
+            return abci.ResponseApplySnapshotChunk(
+                result=abci.APPLY_CHUNK_RETRY
+            )
+        if merkle.leaf_hash(req.chunk) != hashes[req.index]:
+            return abci.ResponseApplySnapshotChunk(
+                result=abci.APPLY_CHUNK_RETRY,
+                refetch_chunks=[req.index],
+                reject_senders=[req.sender] if req.sender else [],
+            )
+        chunks.append(req.chunk)
+        if len(chunks) < snap.chunks:
+            return abci.ResponseApplySnapshotChunk(
+                result=abci.APPLY_CHUNK_ACCEPT
+            )
+        # last chunk: swap in the restored state
+        blob = b"".join(chunks)
+        self._restoring = None
+        try:
+            obj = json.loads(blob.decode())
+            _ = (obj["height"], obj["size"], obj["kv"], obj["vals"])
+        except Exception:
+            return abci.ResponseApplySnapshotChunk(
+                result=abci.APPLY_CHUNK_REJECT_SNAPSHOT
+            )
+        self._db.set_sync(b"kvstore:state", blob)
+        self.state = {}
+        self.validators = {}
+        self._load()
+        return abci.ResponseApplySnapshotChunk(result=abci.APPLY_CHUNK_ACCEPT)
 
     def query(self, req: abci.RequestQuery) -> abci.ResponseQuery:
         if req.path == "/val":

@@ -15,7 +15,17 @@ from __future__ import annotations
 import threading
 from typing import Any, Callable, Optional
 
-import grpc
+# grpc is only needed once a gRPC transport is actually constructed; a missing
+# install must not take down every module that imports the abci tree (the
+# statesync subsystem, proxy.app_conn and the socket transport run fine
+# without it) — same gating as p2p/conn/secret_connection.py's `cryptography`
+try:
+    import grpc
+
+    _GRPC_ERR = None
+except ImportError as _e:  # pragma: no cover - environment-dependent
+    grpc = None
+    _GRPC_ERR = _e
 
 from tendermint_tpu.abci import types as abci
 from tendermint_tpu.libs.service import BaseService
@@ -35,7 +45,18 @@ _METHODS = {
     "InitChain": "init_chain",
     "BeginBlock": "begin_block",
     "EndBlock": "end_block",
+    "ListSnapshots": "list_snapshots",
+    "OfferSnapshot": "offer_snapshot",
+    "LoadSnapshotChunk": "load_snapshot_chunk",
+    "ApplySnapshotChunk": "apply_snapshot_chunk",
 }
+
+
+def _require_grpc(what: str) -> None:
+    if _GRPC_ERR is not None:
+        raise ImportError(
+            f"{what} needs the 'grpcio' package: {_GRPC_ERR}"
+        )
 
 
 class GRPCServer(BaseService):
@@ -43,6 +64,7 @@ class GRPCServer(BaseService):
 
     def __init__(self, addr: str, app: abci.Application):
         super().__init__("abci.GRPCServer")
+        _require_grpc("abci.GRPCServer")
         self.addr = addr.replace("tcp://", "")
         self.app = app
         self._server: Optional[grpc.Server] = None
@@ -102,6 +124,7 @@ class GRPCClient(BaseService):
 
     def __init__(self, addr: str, must_connect: bool = True):
         super().__init__("abci.GRPCClient")
+        _require_grpc("abci.GRPCClient")
         self.addr = addr.replace("tcp://", "")
         self._must_connect = must_connect
         self._channel: Optional[grpc.Channel] = None
@@ -195,6 +218,7 @@ class BroadcastAPIServer(BaseService):
 
     def __init__(self, addr: str, node):
         super().__init__("rpc.GRPCBroadcast")
+        _require_grpc("rpc.BroadcastAPIServer")
         self.addr = addr.replace("tcp://", "")
         self.node = node
         self._server = None
@@ -252,6 +276,7 @@ class BroadcastAPIServer(BaseService):
 
 def broadcast_tx_via_grpc(addr: str, tx: bytes, timeout: float = 10.0) -> dict:
     """Client helper for the BroadcastAPI (rpc/grpc/client_server.go)."""
+    _require_grpc("broadcast_tx_via_grpc")
     import json
 
     channel = grpc.insecure_channel(addr.replace("tcp://", ""))

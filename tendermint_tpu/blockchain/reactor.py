@@ -334,6 +334,35 @@ class BlockchainReactor(Reactor):
         if spec is not None:
             spec[2].cancel()  # not-yet-started work never runs
 
+    def start_from_statesync(self, state) -> None:
+        """Hand-off from a snapshot restore: adopt the reconstructed state
+        and begin fast-syncing from the restore height (the reactor was
+        composed with fast_sync=False so its pool never started from
+        height 1). The pool is rebuilt because its start height was fixed at
+        construction, before the snapshot landed blocks in the store."""
+        self.initial_state = state
+        self.state = state.copy()
+        self.fast_sync = True
+        self._switched.clear()
+        self.pool = BlockPool(
+            start_height=self.store.height() + 1,
+            request_cb=self._send_block_request,
+            error_cb=self._stop_peer_by_id,
+        )
+        if self.metrics is not None:
+            self.metrics.fast_syncing.set(1)
+        self.pool.start()
+        threading.Thread(
+            target=self._pool_routine, name="bc-pool", daemon=True
+        ).start()
+        # peers that connected while we were restoring never got a status
+        # exchange on this channel's sync path — ask for heights now
+        if self.switch is not None:
+            self.switch.broadcast(
+                BLOCKCHAIN_CHANNEL,
+                encode_msg(StatusRequestMessage(self.store.height())),
+            )
+
     def add_peer(self, peer) -> None:
         peer.try_send(
             BLOCKCHAIN_CHANNEL, encode_msg(StatusResponseMessage(self.store.height()))

@@ -7,13 +7,15 @@ Schema (all under one DB):
                      commit that committed block <height>, stored when known)
   SC:<height>     -> SeenCommit (+2/3 precommits we saw locally)
   BH              -> store height
+  BB              -> store base (lowest retained height; >1 after a
+                     state-sync restore or pruning)
 """
 
 from __future__ import annotations
 
 import threading
 from dataclasses import dataclass
-from typing import Optional
+from typing import List, Optional
 
 from tendermint_tpu.encoding.codec import Reader, Writer
 from tendermint_tpu.libs.db.kv import DB
@@ -44,10 +46,19 @@ class BlockStore:
         self._mtx = threading.RLock()
         raw = db.get(b"BH")
         self._height = int(raw.decode()) if raw else 0
+        raw = db.get(b"BB")
+        self._base = int(raw.decode()) if raw else (1 if self._height else 0)
 
     def height(self) -> int:
         with self._mtx:
             return self._height
+
+    def base(self) -> int:
+        """Lowest retained height (store.go Base); 0 for an empty store.
+        A snapshot-restored node starts with base == the first backfilled
+        height, well above 1."""
+        with self._mtx:
+            return self._base
 
     # loads ----------------------------------------------------------------
     def load_block_meta(self, height: int) -> Optional[BlockMeta]:
@@ -104,5 +115,63 @@ class BlockStore:
                 batch.set(b"C:%d" % (height - 1), block.last_commit.marshal())
             batch.set(b"SC:%d" % height, seen_commit.marshal())
             batch.set(b"BH", str(height).encode())
+            if self._base == 0:
+                batch.set(b"BB", str(height).encode())
             batch.write()
             self._height = height
+            if self._base == 0:
+                self._base = height
+
+    def save_statesync_backfill(self, metas: List[BlockMeta], commits) -> None:
+        """Seed an EMPTY store from a state-sync backfill window: block metas
+        + their commits for a contiguous height range ending at the restore
+        height. No block parts exist (the blocks themselves were never
+        fetched) — load_block returns None for these heights, but commits,
+        metas and the seen commit at the top height are enough for consensus
+        hand-off (reconstruct_last_commit) and for serving light clients.
+        Subsequent save_block calls continue contiguously above the top."""
+        if len(metas) != len(commits) or not metas:
+            raise ValueError("backfill needs aligned, non-empty metas/commits")
+        heights = [m.header.height for m in metas]
+        if heights != list(range(heights[0], heights[0] + len(heights))):
+            raise ValueError(f"backfill heights not contiguous: {heights}")
+        with self._mtx:
+            if self._height != 0:
+                raise ValueError(
+                    f"can only seed an empty store (height {self._height})"
+                )
+            batch = self._db.batch()
+            for meta, commit in zip(metas, commits):
+                h = meta.header.height
+                batch.set(b"H:%d" % h, meta.marshal())
+                batch.set(b"C:%d" % h, commit.marshal())
+            top = heights[-1]
+            batch.set(b"SC:%d" % top, commits[-1].marshal())
+            batch.set(b"BH", str(top).encode())
+            batch.set(b"BB", str(heights[0]).encode())
+            batch.write()
+            self._height = top
+            self._base = heights[0]
+
+    def prune(self, retain_height: int) -> int:
+        """Delete everything below `retain_height` (store.go PruneBlocks);
+        returns the number of heights pruned. The top block always survives."""
+        with self._mtx:
+            if retain_height <= self._base:
+                return 0
+            retain_height = min(retain_height, self._height)
+            pruned = 0
+            batch = self._db.batch()
+            for h in range(self._base, retain_height):
+                meta = self.load_block_meta(h)
+                if meta is not None:
+                    for i in range(meta.block_id.parts_header.total):
+                        batch.delete(b"P:%d:%d" % (h, i))
+                batch.delete(b"H:%d" % h)
+                batch.delete(b"C:%d" % h)
+                batch.delete(b"SC:%d" % h)
+                pruned += 1
+            batch.set(b"BB", str(retain_height).encode())
+            batch.write()
+            self._base = retain_height
+            return pruned

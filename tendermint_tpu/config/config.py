@@ -135,6 +135,29 @@ class ConsensusConfig:
 
 
 @dataclass
+class StateSyncConfig:
+    """State sync (snapshot restore + production). `enable` turns on the
+    restore state machine for an empty node; snapshot_interval > 0 turns on
+    snapshot production on any node whose app supports it. The trust root
+    (trust_height + trust_hash, hex of the header hash at that height) comes
+    from social consensus — a block explorer, another operator — exactly as
+    in the reference's [statesync] section."""
+
+    enable: bool = False
+    trust_height: int = 0
+    trust_hash: str = ""
+    discovery_time: float = 1.0  # between snapshot-offer broadcasts (s)
+    chunk_fetch_timeout: float = 10.0  # per chunk/light-block request (s)
+    chunk_retries: int = 3  # attempts per chunk before giving up
+    backfill_blocks: int = 16  # trailing commit window after restore
+    chunk_send_rate: int = 0  # serving-side bytes/s cap; 0 = unlimited
+    # producer side
+    snapshot_interval: int = 0  # take a snapshot every N heights; 0 = off
+    snapshot_chunk_size: int = 65536
+    snapshot_keep_recent: int = 3
+
+
+@dataclass
 class TxIndexConfig:
     indexer: str = "kv"  # "kv" | "null"
     index_tags: str = ""
@@ -156,6 +179,7 @@ class Config:
     p2p: P2PConfig = field(default_factory=P2PConfig)
     mempool: MempoolConfig = field(default_factory=MempoolConfig)
     consensus: ConsensusConfig = field(default_factory=ConsensusConfig)
+    statesync: StateSyncConfig = field(default_factory=StateSyncConfig)
     tx_index: TxIndexConfig = field(default_factory=TxIndexConfig)
     instrumentation: InstrumentationConfig = field(default_factory=InstrumentationConfig)
 

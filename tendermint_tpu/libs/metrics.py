@@ -351,6 +351,68 @@ def get_verify_metrics() -> VerifyMetrics:
         return _verify_metrics
 
 
+class StateSyncMetrics:
+    """State-sync telemetry: snapshot restore progress on the client side
+    (chunk fetch outcomes, restore latency, backfill window size) and
+    serving counters on the provider side. Process-wide like VerifyMetrics —
+    the reactor can outlive a node object across restore retries."""
+
+    def __init__(self, registry: Optional[Registry] = None):
+        r = registry or Registry()
+        self.registry = r
+        self.syncing = r.gauge(
+            "statesync_syncing", "1 while a snapshot restore is in progress"
+        )
+        self.snapshot_height = r.gauge(
+            "statesync_snapshot_height", "Height of the snapshot being restored"
+        )
+        self.chunks_expected = r.gauge(
+            "statesync_chunks_expected", "Chunks in the snapshot being restored"
+        )
+        self.chunks_applied = r.gauge(
+            "statesync_chunks_applied", "Chunks applied so far"
+        )
+        self.chunk_fetch = r.counter(
+            "statesync_chunk_fetch_total",
+            "Chunk fetch attempts by outcome (ok|bad|timeout|missing)",
+            label_names=("outcome",),
+        )
+        self.chunk_bytes = r.counter(
+            "statesync_chunk_bytes_total", "Verified chunk bytes received"
+        )
+        self.served = r.counter(
+            "statesync_served_total",
+            "Requests served to restoring peers by message type",
+            label_names=("msg",),
+        )
+        self.chunk_fetch_seconds = r.histogram(
+            "statesync_chunk_fetch_seconds", "Per-chunk fetch wall seconds"
+        )
+        self.backfill_heights = r.histogram(
+            "statesync_backfill_heights",
+            "Heights in the trailing commit backfill window",
+            buckets=tuple(float(1 << i) for i in range(11)),
+        )
+        self.restore_seconds = r.histogram(
+            "statesync_restore_seconds",
+            "End-to-end snapshot restore wall seconds",
+            buckets=(0.1, 0.5, 1.0, 5.0, 15.0, 60.0, 300.0, 1800.0),
+        )
+
+
+_statesync_mtx = threading.Lock()
+_statesync_metrics: Optional[StateSyncMetrics] = None
+
+
+def get_statesync_metrics() -> StateSyncMetrics:
+    """Process-wide StateSyncMetrics singleton (mirrors get_verify_metrics)."""
+    global _statesync_metrics
+    with _statesync_mtx:
+        if _statesync_metrics is None:
+            _statesync_metrics = StateSyncMetrics()
+        return _statesync_metrics
+
+
 class NodeMetrics:
     """All four reference metric families on one registry
     (consensus/metrics.go:14, p2p/metrics.go, mempool/metrics.go,
@@ -390,9 +452,12 @@ class NodeMetrics:
             "state_block_processing_time", "ApplyBlock seconds",
             buckets=[b / 10 for b in _DEFAULT_BUCKETS],
         )
-        # verify pipeline (process-global; attached, not re-registered)
+        # verify pipeline + state sync (process-global; attached, not
+        # re-registered)
         self.verify = get_verify_metrics()
         r.attach(self.verify.registry)
+        self.statesync = get_statesync_metrics()
+        r.attach(self.statesync.registry)
         self._last_block_time: Optional[float] = None
 
     # called from the consensus event path -------------------------------------

@@ -78,6 +78,24 @@ class Node(BaseService):
         self.proxy_app = MultiAppConn(creator)
         self.proxy_app.start()
 
+        # state-sync snapshot store (serves restoring peers; feeds the
+        # producer when snapshot_interval > 0)
+        self.snapshot_store = None
+        if config.statesync.enable or config.statesync.snapshot_interval > 0:
+            from tendermint_tpu.statesync import SnapshotStore
+
+            self.snapshot_store = SnapshotStore(_db("snapshots"))
+            app = getattr(creator, "_app", None)
+            if config.statesync.snapshot_interval > 0 and hasattr(
+                app, "configure_snapshots"
+            ):
+                app.configure_snapshots(
+                    self.snapshot_store,
+                    config.statesync.snapshot_interval,
+                    config.statesync.snapshot_chunk_size,
+                    config.statesync.snapshot_keep_recent,
+                )
+
         # handshake: sync app with store/state
         handshaker = Handshaker(
             self.state_db, state, self.block_store, genesis_doc
@@ -196,6 +214,7 @@ class Node(BaseService):
         self.switch = None
         self.consensus_reactor = None
         self.blockchain_reactor = None
+        self.statesync_reactor = None
         if config.p2p.laddr:
             self._build_p2p(config, state)
 
@@ -228,17 +247,46 @@ class Node(BaseService):
             only_val = state.validators.validators[0]
             if self.priv_validator.get_pub_key().address() == only_val.address:
                 fast_sync = False
+        # State sync restores only a node with NO history: with blocks on
+        # disk the regular fast-sync path is strictly safer (and a restored
+        # height below ours would be a rollback).
+        restoring = config.statesync.enable and state.last_block_height == 0
+        # While restoring, consensus defers (as in fast sync) and the
+        # blockchain reactor must NOT start its pool at height 1 — the
+        # statesync hand-off rebases it above the snapshot height.
         self.consensus_reactor = ConsensusReactor(
-            self.consensus_state, fast_sync=fast_sync
+            self.consensus_state, fast_sync=fast_sync or restoring
         )
         self.blockchain_reactor = BlockchainReactor(
             state.copy(),
             self.block_exec,
             self.block_store,
-            fast_sync=fast_sync,
+            fast_sync=fast_sync and not restoring,
             consensus_reactor=self.consensus_reactor,
             metrics=self.metrics,
         )
+        if config.statesync.enable or config.statesync.snapshot_interval > 0:
+            from tendermint_tpu.statesync import StateSyncReactor, StateSyncer
+
+            syncer = None
+            if restoring:
+                syncer = StateSyncer(
+                    config.statesync,
+                    self.genesis_doc.chain_id,
+                    self.genesis_doc,
+                    self.proxy_app.query,
+                    self.state_db,
+                    self.block_store,
+                )
+            self.statesync_reactor = StateSyncReactor(
+                config.statesync,
+                app_query=self.proxy_app.query,
+                snapshot_store=self.snapshot_store,
+                block_store=self.block_store,
+                state_db=self.state_db,
+                syncer=syncer,
+                on_synced=self._on_statesync_complete,
+            )
         mem_reactor = MempoolReactor(
             self.mempool,
             peer_height_lookup=self.consensus_reactor.peer_height,
@@ -281,6 +329,8 @@ class Node(BaseService):
             self.consensus_reactor, self.blockchain_reactor, mem_reactor,
             ev_reactor,
         ]
+        if self.statesync_reactor is not None:
+            reactors.append(self.statesync_reactor)
         if pex_reactor is not None:
             reactors.append(pex_reactor)
         channels = bytes(
@@ -359,8 +409,22 @@ class Node(BaseService):
         self.switch.add_reactor("blockchain", self.blockchain_reactor)
         self.switch.add_reactor("mempool", mem_reactor)
         self.switch.add_reactor("evidence", ev_reactor)
+        if self.statesync_reactor is not None:
+            self.switch.add_reactor("statesync", self.statesync_reactor)
         if pex_reactor is not None:
             self.switch.add_reactor("pex", pex_reactor)
+
+    def _on_statesync_complete(self, state, height: int) -> None:
+        """Snapshot restore finished: the syncer persisted state + backfill;
+        hand the reconstructed state to fast sync, which catches the trailing
+        blocks and switches to consensus as usual."""
+        self.logger.info("state sync restored height %d — starting fast sync", height)
+        try:
+            self.mempool.update(height, [])
+        except Exception:
+            self.logger.exception("mempool height update after restore failed")
+        if self.blockchain_reactor is not None:
+            self.blockchain_reactor.start_from_statesync(state)
 
     # lifecycle -------------------------------------------------------------
     def on_start(self) -> None:

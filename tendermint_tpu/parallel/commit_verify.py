@@ -29,6 +29,13 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+# jax.enable_x64 is only a public re-export on some versions; the
+# experimental spelling is the one that exists everywhere we run
+try:
+    _enable_x64 = jax.enable_x64
+except AttributeError:
+    from jax.experimental import enable_x64 as _enable_x64
+
 from tendermint_tpu.libs import trace
 from tendermint_tpu.libs.metrics import get_verify_metrics
 from tendermint_tpu.ops import ed25519_verify as _k
@@ -181,7 +188,7 @@ def verify_commit_window(
     n = int(np.count_nonzero(win.present))
     t0 = time.perf_counter()
     with trace.span("verify.window_dispatch", backend=backend, H=H, V=V, n=n):
-        with jax.enable_x64(True):
+        with _enable_x64(True):
             if mesh is not None:
                 from jax.sharding import NamedSharding, PartitionSpec as PS
 

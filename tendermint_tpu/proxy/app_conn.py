@@ -84,6 +84,28 @@ class AppConnQuery:
     def set_option_sync(self, req: abci.RequestSetOption) -> abci.ResponseSetOption:
         return self._c.request_sync(req)
 
+    # state-sync snapshot handshake rides the query connection (the reference
+    # v0.34 adds a fourth conn; the method set is what matters here)
+    def list_snapshots_sync(
+        self, req: Optional[abci.RequestListSnapshots] = None
+    ) -> abci.ResponseListSnapshots:
+        return self._c.request_sync(req or abci.RequestListSnapshots())
+
+    def offer_snapshot_sync(
+        self, req: abci.RequestOfferSnapshot
+    ) -> abci.ResponseOfferSnapshot:
+        return self._c.request_sync(req)
+
+    def load_snapshot_chunk_sync(
+        self, req: abci.RequestLoadSnapshotChunk
+    ) -> abci.ResponseLoadSnapshotChunk:
+        return self._c.request_sync(req)
+
+    def apply_snapshot_chunk_sync(
+        self, req: abci.RequestApplySnapshotChunk
+    ) -> abci.ResponseApplySnapshotChunk:
+        return self._c.request_sync(req)
+
 
 # ---------------------------------------------------------------------------
 # Client creators (ref proxy/client.go)

@@ -282,6 +282,14 @@ class RPCEnv:
             }
         }
 
+    def statesync(self) -> dict:
+        """Snapshot restore / serving progress (chunks applied, backfill
+        window, hand-off height) from the statesync reactor."""
+        reactor = getattr(self.node, "statesync_reactor", None)
+        if reactor is None:
+            return {"enabled": False}
+        return reactor.progress()
+
     def net_info(self) -> dict:
         sw = getattr(self.node, "switch", None)
         peers = []

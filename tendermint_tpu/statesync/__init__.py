@@ -1,0 +1,33 @@
+"""State sync — snapshot/chunk bootstrap with light-client trust and
+TPU-batched commit backfill (v0.34 lineage; see README "State sync").
+
+  chunker   — fixed-size chunking + Merkle chunk manifest
+  store     — persisted snapshots + chunks (the producer side)
+  messages  — p2p wire messages for the statesync channel (0x60)
+  reactor   — serves snapshots/chunks/light blocks; hosts the syncer
+  syncer    — discovery → light-client verify → restore → batched backfill
+"""
+
+from tendermint_tpu.statesync.chunker import (
+    chunk_hashes_from_metadata,
+    chunk_state,
+    make_snapshot,
+    manifest_root,
+    verify_chunk,
+)
+from tendermint_tpu.statesync.reactor import STATESYNC_CHANNEL, StateSyncReactor
+from tendermint_tpu.statesync.store import SnapshotStore
+from tendermint_tpu.statesync.syncer import StateSyncError, StateSyncer
+
+__all__ = [
+    "STATESYNC_CHANNEL",
+    "SnapshotStore",
+    "StateSyncError",
+    "StateSyncReactor",
+    "StateSyncer",
+    "chunk_hashes_from_metadata",
+    "chunk_state",
+    "make_snapshot",
+    "manifest_root",
+    "verify_chunk",
+]

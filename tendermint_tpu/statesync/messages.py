@@ -1,0 +1,150 @@
+"""State-sync wire messages, channel 0x60 (v0.34 statesync lineage:
+SnapshotsRequest/Response + ChunkRequest/Response, plus a light-block
+fetch so the restoring node's lite verifier and commit backfill ride the
+same channel).
+
+Same 1-byte-tag + codec-body convention as the blockchain registry.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List
+
+from tendermint_tpu.abci import types as abci
+from tendermint_tpu.encoding.codec import Reader, Writer
+
+
+def _encode_snapshot(w: Writer, s: abci.Snapshot) -> None:
+    w.svarint(s.height)
+    w.uvarint(s.format)
+    w.uvarint(s.chunks)
+    w.bytes(s.hash)
+    w.bytes(s.metadata)
+
+
+def _decode_snapshot(r: Reader) -> abci.Snapshot:
+    return abci.Snapshot(
+        height=r.svarint(),
+        format=r.uvarint(),
+        chunks=r.uvarint(),
+        hash=r.bytes(),
+        metadata=r.bytes(),
+    )
+
+
+@dataclass
+class SnapshotsRequestMessage:
+    """Ask a peer for its snapshot offers."""
+
+    def encode(self, w: Writer) -> None:
+        pass
+
+    @classmethod
+    def decode(cls, r: Reader) -> "SnapshotsRequestMessage":
+        return cls()
+
+
+@dataclass
+class SnapshotsResponseMessage:
+    snapshots: List[abci.Snapshot] = field(default_factory=list)
+
+    def encode(self, w: Writer) -> None:
+        w.uvarint(len(self.snapshots))
+        for s in self.snapshots:
+            _encode_snapshot(w, s)
+
+    @classmethod
+    def decode(cls, r: Reader) -> "SnapshotsResponseMessage":
+        n = r.uvarint()
+        if n > 64:
+            raise ValueError(f"too many snapshot offers ({n})")
+        return cls([_decode_snapshot(r) for _ in range(n)])
+
+
+@dataclass
+class ChunkRequestMessage:
+    height: int
+    format: int
+    index: int
+
+    def encode(self, w: Writer) -> None:
+        w.svarint(self.height)
+        w.uvarint(self.format)
+        w.uvarint(self.index)
+
+    @classmethod
+    def decode(cls, r: Reader) -> "ChunkRequestMessage":
+        return cls(r.svarint(), r.uvarint(), r.uvarint())
+
+
+@dataclass
+class ChunkResponseMessage:
+    height: int
+    format: int
+    index: int
+    chunk: bytes = b""
+    missing: bool = False  # peer doesn't have this chunk
+
+    def encode(self, w: Writer) -> None:
+        w.svarint(self.height)
+        w.uvarint(self.format)
+        w.uvarint(self.index)
+        w.bytes(self.chunk)
+        w.bool(self.missing)
+
+    @classmethod
+    def decode(cls, r: Reader) -> "ChunkResponseMessage":
+        return cls(r.svarint(), r.uvarint(), r.uvarint(), r.bytes(), r.bool())
+
+
+@dataclass
+class LightBlockRequestMessage:
+    height: int
+
+    def encode(self, w: Writer) -> None:
+        w.svarint(self.height)
+
+    @classmethod
+    def decode(cls, r: Reader) -> "LightBlockRequestMessage":
+        return cls(r.svarint())
+
+
+@dataclass
+class LightBlockResponseMessage:
+    height: int
+    full_commit: bytes = b""  # FullCommit.marshal(); empty = not available
+
+    def encode(self, w: Writer) -> None:
+        w.svarint(self.height)
+        w.bytes(self.full_commit)
+
+    @classmethod
+    def decode(cls, r: Reader) -> "LightBlockResponseMessage":
+        return cls(r.svarint(), r.bytes())
+
+
+_REGISTRY = [
+    SnapshotsRequestMessage,
+    SnapshotsResponseMessage,
+    ChunkRequestMessage,
+    ChunkResponseMessage,
+    LightBlockRequestMessage,
+    LightBlockResponseMessage,
+]
+_TAG = {cls: i + 1 for i, cls in enumerate(_REGISTRY)}
+
+
+def encode_msg(msg) -> bytes:
+    w = Writer()
+    w.uvarint(_TAG[type(msg)])
+    msg.encode(w)
+    return w.build()
+
+
+def unmarshal_msg(data: bytes):
+    r = Reader(data)
+    tag = r.uvarint()
+    if not (1 <= tag <= len(_REGISTRY)):
+        raise ValueError(f"unknown statesync message tag {tag}")
+    return _REGISTRY[tag - 1].decode(r)

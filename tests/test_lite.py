@@ -170,3 +170,106 @@ class TestDynamicVerifier:
         dv = DynamicVerifier(fx.chain_id, DBProvider(MemDB()), src)
         with pytest.raises(LiteError):
             dv.verify(src.full_commit_at(fx.chain_id, 5).signed_header)
+
+
+class _DoctoringProvider:
+    """Source provider wrapper that rewrites served FullCommits — a lying or
+    pruned peer, as state sync's reactor provider can encounter."""
+
+    def __init__(self, inner, doctor):
+        self._inner = inner
+        self._doctor = doctor  # (height, fc) -> fc (may raise)
+
+    def full_commit_at(self, chain_id, height):
+        return self._doctor(height, self._inner.full_commit_at(chain_id, height))
+
+    def latest_full_commit(self, chain_id, min_height, max_height):
+        return self.full_commit_at(chain_id, max_height)
+
+
+class TestDynamicVerifierRejections:
+    """The rejection paths a state-syncing node depends on: each one is a
+    peer-supplied FullCommit that must NOT become trusted."""
+
+    def test_rejects_valset_hash_mismatch(self, static_chain):
+        """A served FullCommit whose validator set disagrees with the
+        header's validators_hash dies in validate_full, before any
+        signature work."""
+        from tendermint_tpu.crypto.keys import PrivKeyEd25519 as PK
+        from tendermint_tpu.types.validator_set import Validator, ValidatorSet
+
+        fx = static_chain
+        strangers = ValidatorSet(
+            [Validator(PK.generate(bytes([210 + i]) * 32).pub_key(), 10)
+             for i in range(4)]
+        )
+
+        def swap_valset(height, fc):
+            if height >= 5:
+                fc.validators = strangers
+            return fc
+
+        src = _DoctoringProvider(
+            NodeProvider(fx.block_store, fx.state_db), swap_valset
+        )
+        trusted = DBProvider(MemDB())
+        dv = DynamicVerifier(fx.chain_id, trusted, src)
+        dv.init_from_full_commit(src.full_commit_at(fx.chain_id, 1))
+        header7 = NodeProvider(fx.block_store, fx.state_db).full_commit_at(
+            fx.chain_id, 7
+        ).signed_header
+        with pytest.raises(LiteError, match="validators_hash"):
+            dv.verify(header7)
+        # nothing above the seed became trusted
+        assert trusted.latest_full_commit(fx.chain_id, 1, 10).height == 1
+
+    def test_rejects_insufficient_power_at_trusted_ancestor(self, static_chain):
+        """Commits stripped to a minority of the trusted ancestor's power
+        (2 of 4 equal validators is not > 2/3) never extend trust."""
+        from tendermint_tpu.types.validator_set import CommitError
+
+        fx = static_chain
+
+        def strip_commit(height, fc):
+            if height > 1:
+                pcs = fc.signed_header.commit.precommits
+                pcs[0] = None
+                pcs[1] = None
+            return fc
+
+        src = _DoctoringProvider(
+            NodeProvider(fx.block_store, fx.state_db), strip_commit
+        )
+        dv = DynamicVerifier(fx.chain_id, DBProvider(MemDB()), src)
+        dv.init_from_full_commit(src.full_commit_at(fx.chain_id, 1))
+        header9 = NodeProvider(fx.block_store, fx.state_db).full_commit_at(
+            fx.chain_id, 9
+        ).signed_header
+        with pytest.raises(CommitError, match="voting power"):
+            dv.verify(header9)
+
+    def test_bisection_across_big_churn_fails_when_intermediates_pruned(
+        self, churn_chain
+    ):
+        """>1/3 of the valset changed between the trusted height and the tip,
+        so the single hop raises TooMuchChange and the verifier must bisect —
+        when the source cannot serve the midpoint heights (pruned peer), the
+        tip is unverifiable and must be rejected, not trusted."""
+        fx = churn_chain
+        honest = NodeProvider(fx.block_store, fx.state_db)
+
+        def prune_middle(height, fc):
+            if 2 < height < 13:
+                raise ProviderError(f"height {height} pruned")
+            return fc
+
+        src = _DoctoringProvider(honest, prune_middle)
+        dv = DynamicVerifier(fx.chain_id, DBProvider(MemDB()), src)
+        dv.init_from_full_commit(src.full_commit_at(fx.chain_id, 2))
+        tip = honest.full_commit_at(fx.chain_id, 13).signed_header
+        with pytest.raises(LiteError):
+            dv.verify(tip)
+        # the same tip verifies once the intermediates are available again
+        dv2 = DynamicVerifier(fx.chain_id, DBProvider(MemDB()), honest)
+        dv2.init_from_full_commit(honest.full_commit_at(fx.chain_id, 2))
+        dv2.verify(tip)

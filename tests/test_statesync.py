@@ -1,0 +1,731 @@
+"""State sync: chunker/manifest, snapshot store, ABCI snapshot handshake,
+block-store seeding, TPU-batched backfill verification, and the full
+restore-over-p2p flow (ref: v0.34 statesync/{syncer,reactor}_test.go).
+"""
+
+import dataclasses
+import threading
+
+import pytest
+
+from tendermint_tpu.abci import types as abci
+from tendermint_tpu.abci.examples.kvstore import PersistentKVStoreApp
+from tendermint_tpu.blockchain.store import BlockStore
+from tendermint_tpu.config.config import StateSyncConfig
+from tendermint_tpu.crypto import merkle
+from tendermint_tpu.libs.db.kv import MemDB
+from tendermint_tpu.libs.metrics import StateSyncMetrics
+from tendermint_tpu.lite.provider import NodeProvider
+from tendermint_tpu.proxy.app_conn import LocalClientCreator, MultiAppConn
+from tendermint_tpu.state import store as sm_store
+from tendermint_tpu.state.state_types import state_from_genesis
+from tendermint_tpu.statesync import chunker
+from tendermint_tpu.statesync.messages import (
+    ChunkRequestMessage,
+    ChunkResponseMessage,
+    LightBlockRequestMessage,
+    LightBlockResponseMessage,
+    SnapshotsRequestMessage,
+    SnapshotsResponseMessage,
+    encode_msg,
+    unmarshal_msg,
+)
+from tendermint_tpu.statesync.reactor import StateSyncReactor
+from tendermint_tpu.statesync.store import SnapshotStore
+from tendermint_tpu.statesync.syncer import (
+    StateSyncer,
+    _SnapshotRejected,
+)
+from tendermint_tpu.testutil.chain import build_chain
+from tendermint_tpu.types.validator_set import CommitError
+
+from tests.consensus_harness import wait_for
+
+
+# ---------------------------------------------------------------------------
+# chunker + manifest
+# ---------------------------------------------------------------------------
+
+
+class TestChunker:
+    def test_round_trip(self):
+        data = bytes(range(256)) * 5
+        snap, chunks = chunker.make_snapshot(7, data, chunk_size=100)
+        assert snap.height == 7
+        assert snap.format == chunker.SNAPSHOT_FORMAT
+        assert snap.chunks == len(chunks) == 13
+        assert b"".join(chunks) == data
+        hashes = chunker.chunk_hashes_from_metadata(snap)
+        for i, c in enumerate(chunks):
+            assert chunker.verify_chunk(c, i, hashes)
+
+    def test_empty_blob_is_one_empty_chunk(self):
+        snap, chunks = chunker.make_snapshot(1, b"")
+        assert snap.chunks == 1 and chunks == [b""]
+        hashes = chunker.chunk_hashes_from_metadata(snap)
+        assert chunker.verify_chunk(b"", 0, hashes)
+
+    def test_corrupted_chunk_detected(self):
+        data = bytes(range(256)) + b"tail" * 11
+        snap, chunks = chunker.make_snapshot(3, data, chunk_size=100)
+        hashes = chunker.chunk_hashes_from_metadata(snap)
+        assert not chunker.verify_chunk(b"y" * 100, 1, hashes)
+        assert not chunker.verify_chunk(chunks[0], 1, hashes)  # wrong slot
+        assert not chunker.verify_chunk(chunks[0], 99, hashes)  # bad index
+
+    def test_lying_manifest_rejected(self):
+        snap, _ = chunker.make_snapshot(3, b"x" * 300, chunk_size=100)
+        # root disagrees with the manifest
+        bad = dataclasses.replace(snap, hash=b"\xde" * 32)
+        with pytest.raises(ValueError, match="manifest root"):
+            chunker.chunk_hashes_from_metadata(bad)
+        # manifest length disagrees with the chunk count
+        bad = dataclasses.replace(snap, metadata=snap.metadata[:-1])
+        with pytest.raises(ValueError, match="manifest"):
+            chunker.chunk_hashes_from_metadata(bad)
+
+    def test_bad_chunk_size_rejected(self):
+        with pytest.raises(ValueError):
+            chunker.chunk_state(b"abc", chunk_size=0)
+
+
+# ---------------------------------------------------------------------------
+# SnapshotStore
+# ---------------------------------------------------------------------------
+
+
+class TestSnapshotStore:
+    def _store_with(self, heights):
+        store = SnapshotStore(MemDB())
+        for h in heights:
+            snap, chunks = chunker.make_snapshot(
+                h, b"state-at-%d" % h * 20, chunk_size=64
+            )
+            store.save(snap, chunks)
+        return store
+
+    def test_save_list_load(self):
+        store = self._store_with([4, 8, 12])
+        snaps = store.list()
+        assert [s.height for s in snaps] == [12, 8, 4]  # tallest first
+        snap = store.get(8, chunker.SNAPSHOT_FORMAT)
+        assert snap is not None and snap.chunks > 1
+        got = b"".join(
+            store.load_chunk(8, snap.format, i) for i in range(snap.chunks)
+        )
+        assert got == b"state-at-8" * 20
+        assert store.load_chunk(8, snap.format, snap.chunks) is None
+        assert store.get(99, snap.format) is None
+
+    def test_save_checks_chunk_count(self):
+        store = SnapshotStore(MemDB())
+        snap, chunks = chunker.make_snapshot(1, b"abc")
+        with pytest.raises(ValueError):
+            store.save(snap, chunks + [b"extra"])
+
+    def test_prune_keeps_tallest(self):
+        store = self._store_with([4, 8, 12, 16])
+        assert store.prune(keep_recent=2) == 2
+        assert [s.height for s in store.list()] == [16, 12]
+        assert store.get(4, chunker.SNAPSHOT_FORMAT) is None
+        assert store.load_chunk(4, chunker.SNAPSHOT_FORMAT, 0) is None
+        assert store.prune(keep_recent=2) == 0
+
+
+# ---------------------------------------------------------------------------
+# wire messages
+# ---------------------------------------------------------------------------
+
+
+class TestMessages:
+    def test_round_trips(self):
+        snap, _ = chunker.make_snapshot(5, b"z" * 100, chunk_size=40)
+        msgs = [
+            SnapshotsRequestMessage(),
+            SnapshotsResponseMessage(snapshots=[snap]),
+            ChunkRequestMessage(height=5, format=1, index=2),
+            ChunkResponseMessage(height=5, format=1, index=2, chunk=b"abc"),
+            ChunkResponseMessage(height=5, format=1, index=0, chunk=b"", missing=True),
+            LightBlockRequestMessage(height=9),
+            LightBlockResponseMessage(height=9, full_commit=b"\x01\x02"),
+        ]
+        for m in msgs:
+            assert unmarshal_msg(encode_msg(m)) == m
+
+    def test_unknown_tag_rejected(self):
+        with pytest.raises(ValueError):
+            unmarshal_msg(b"\xff\x00")
+        with pytest.raises(Exception):
+            unmarshal_msg(b"")
+
+
+# ---------------------------------------------------------------------------
+# kvstore ABCI snapshot handshake
+# ---------------------------------------------------------------------------
+
+
+def _run_blocks(app, start, stop, txs_for):
+    for h in range(start, stop + 1):
+        app.begin_block(abci.RequestBeginBlock())
+        for tx in txs_for(h):
+            assert app.deliver_tx(abci.RequestDeliverTx(tx=tx)).code == 0
+        app.end_block(abci.RequestEndBlock())
+        app.commit(abci.RequestCommit())
+
+
+class TestKVStoreSnapshotHandshake:
+    def _producer(self, interval=3, chunk_size=32, heights=6):
+        app = PersistentKVStoreApp()
+        store = SnapshotStore(MemDB())
+        app.configure_snapshots(store, interval, chunk_size=chunk_size)
+        _run_blocks(
+            app, 1, heights,
+            lambda h: [b"k%d-%d=v%d" % (h, j, h) for j in range(3)],
+        )
+        return app, store
+
+    def test_producer_snapshots_at_interval(self):
+        app, store = self._producer(interval=3, heights=7)
+        assert [s.height for s in store.list()] == [6, 3]
+        snap = store.get(6, chunker.SNAPSHOT_FORMAT)
+        hashes = chunker.chunk_hashes_from_metadata(snap)
+        assert len(hashes) == snap.chunks > 1
+
+    def test_producer_prunes_old_snapshots(self):
+        app = PersistentKVStoreApp()
+        store = SnapshotStore(MemDB())
+        app.configure_snapshots(store, 2, keep_recent=2)
+        _run_blocks(app, 1, 10, lambda h: [b"a%d=b" % h])
+        assert [s.height for s in store.list()] == [10, 8]
+
+    def test_restore_round_trip_with_corrupt_chunk_retry(self):
+        app, store = self._producer(interval=3, heights=6)
+        snap = store.get(6, chunker.SNAPSHOT_FORMAT)
+
+        app2 = PersistentKVStoreApp()
+        res = app2.offer_snapshot(
+            abci.RequestOfferSnapshot(snapshot=snap, app_hash=app._app_hash())
+        )
+        assert res.result == abci.OFFER_SNAPSHOT_ACCEPT
+
+        # out-of-order chunk is a RETRY, not corruption
+        res = app2.apply_snapshot_chunk(
+            abci.RequestApplySnapshotChunk(index=1, chunk=b"x")
+        )
+        assert res.result == abci.APPLY_CHUNK_RETRY
+
+        for i in range(snap.chunks):
+            chunk = store.load_chunk(snap.height, snap.format, i)
+            if i == 1:
+                # a corrupted chunk: refetch it, punish the sender
+                res = app2.apply_snapshot_chunk(
+                    abci.RequestApplySnapshotChunk(
+                        index=i, chunk=b"garbage", sender="evil-peer"
+                    )
+                )
+                assert res.result == abci.APPLY_CHUNK_RETRY
+                assert res.refetch_chunks == [i]
+                assert res.reject_senders == ["evil-peer"]
+            res = app2.apply_snapshot_chunk(
+                abci.RequestApplySnapshotChunk(index=i, chunk=chunk)
+            )
+            assert res.result == abci.APPLY_CHUNK_ACCEPT
+
+        assert app2.height == 6
+        assert app2.size == app.size
+        assert app2.state == app.state
+        assert app2.validators == app.validators
+        assert app2._app_hash() == app._app_hash()
+        # restored app persisted the exact snapshot blob
+        assert app2._db.get(b"kvstore:state") == app._db.get(b"kvstore:state")
+
+    def test_offer_rejects_bad_snapshots(self):
+        app = PersistentKVStoreApp()
+        snap, _ = chunker.make_snapshot(5, b"blob")
+        wrong_fmt = dataclasses.replace(snap, format=99)
+        res = app.offer_snapshot(abci.RequestOfferSnapshot(snapshot=wrong_fmt))
+        assert res.result == abci.OFFER_SNAPSHOT_REJECT_FORMAT
+        lying = dataclasses.replace(snap, hash=b"\xab" * 32)
+        res = app.offer_snapshot(abci.RequestOfferSnapshot(snapshot=lying))
+        assert res.result == abci.OFFER_SNAPSHOT_REJECT
+        res = app.offer_snapshot(abci.RequestOfferSnapshot(snapshot=None))
+        assert res.result == abci.OFFER_SNAPSHOT_REJECT
+        # apply without an accepted offer aborts
+        res = app.apply_snapshot_chunk(
+            abci.RequestApplySnapshotChunk(index=0, chunk=b"")
+        )
+        assert res.result == abci.APPLY_CHUNK_ABORT
+
+
+# ---------------------------------------------------------------------------
+# BlockStore: base, prune, state-sync seeding
+# ---------------------------------------------------------------------------
+
+
+class TestBlockStoreBaseAndPrune:
+    @pytest.fixture(scope="class")
+    def fx(self):
+        return build_chain(n_vals=2, n_heights=8, chain_id="bs-prune")
+
+    def test_base_tracks_first_block(self, fx):
+        assert fx.block_store.base() == 1
+        assert BlockStore(MemDB()).base() == 0
+
+    def test_prune_drops_history_below_retain(self):
+        fx = build_chain(n_vals=1, n_heights=6, chain_id="bs-prune-w")
+        store = fx.block_store
+        assert store.prune(4) == 3
+        assert store.base() == 4 and store.height() == 6
+        assert store.load_block(3) is None
+        assert store.load_block_meta(3) is None
+        assert store.load_block_commit(3) is None
+        assert store.load_block(4) is not None
+        # below base: no-op; above height: clamps, the top block survives
+        assert store.prune(2) == 0
+        assert store.prune(100) == 2
+        assert store.base() == 6
+        assert store.load_block(6) is not None
+        # base survives a reopen
+        store2 = BlockStore(store._db)
+        assert store2.base() == 6 and store2.height() == 6
+
+    def test_backfill_seeds_empty_store(self, fx):
+        metas = [fx.block_store.load_block_meta(h) for h in range(4, 8)]
+        commits = [fx.block_store.load_block_commit(h) for h in range(4, 8)]
+        store = BlockStore(MemDB())
+        store.save_statesync_backfill(metas, commits)
+        assert store.height() == 7 and store.base() == 4
+        # metas + commits served, but no parts → no full blocks
+        assert store.load_block_meta(5) is not None
+        assert store.load_block_commit(5) is not None
+        assert store.load_block(5) is None
+        assert store.load_seen_commit(7) is not None
+        # fast sync continues contiguously above the seeded top
+        block = fx.block_store.load_block(8)
+        store.save_block(
+            block, block.make_part_set(), fx.block_store.load_seen_commit(8)
+        )
+        assert store.height() == 8 and store.base() == 4
+        assert store.load_block(8) is not None
+
+    def test_backfill_rejects_bad_input(self, fx):
+        metas = [fx.block_store.load_block_meta(h) for h in (4, 6)]
+        commits = [fx.block_store.load_block_commit(h) for h in (4, 6)]
+        store = BlockStore(MemDB())
+        with pytest.raises(ValueError, match="contiguous"):
+            store.save_statesync_backfill(metas, commits)
+        with pytest.raises(ValueError, match="non-empty"):
+            store.save_statesync_backfill([], [])
+        # only an EMPTY store can be seeded
+        with pytest.raises(ValueError, match="empty"):
+            fx.block_store.save_statesync_backfill(
+                [fx.block_store.load_block_meta(4)],
+                [fx.block_store.load_block_commit(4)],
+            )
+
+
+# ---------------------------------------------------------------------------
+# backfill window: one batched dispatch, bit-exact with the host verifier
+# ---------------------------------------------------------------------------
+
+
+def _syncer_for(fx, backfill_blocks=4):
+    cfg = StateSyncConfig(backfill_blocks=backfill_blocks)
+    return StateSyncer(
+        cfg, fx.chain_id, fx.genesis, None, MemDB(), BlockStore(MemDB()),
+        metrics=StateSyncMetrics(),
+    )
+
+
+def _window(fx, lo, hi):
+    provider = NodeProvider(fx.block_store, fx.state_db)
+    return [
+        provider.full_commit_at(fx.chain_id, h) for h in range(lo, hi + 1)
+    ]
+
+
+class TestBackfillWindowBitExact:
+    @pytest.fixture(scope="class")
+    def fx(self):
+        return build_chain(n_vals=4, n_heights=10, chain_id="bf-chain")
+
+    def test_valid_window_accepted_by_device_and_host(self, fx):
+        fcs = _window(fx, 6, 9)
+        _syncer_for(fx)._verify_backfill_window(fcs)  # no raise
+        for fc in fcs:  # the host verifier agrees, height by height
+            sh = fc.signed_header
+            fc.validators.verify_commit(
+                fx.chain_id, sh.commit.block_id, fc.height, sh.commit
+            )
+
+    def test_device_verdict_matches_per_signature_host_verify(self, fx):
+        """The batched (H, V) dispatch is bit-exact with per-signature host
+        verification — including a tampered signature in the middle."""
+        from tendermint_tpu.parallel import commit_verify as cv
+
+        fcs = _window(fx, 6, 9)
+        pc = fcs[2].signed_header.commit.precommits[1]
+        fcs[2].signed_header.commit.precommits[1] = dataclasses.replace(
+            pc, signature=b"\x00" * 64
+        )
+        votes_rows, power_rows, totals = [], [], []
+        for fc in fcs:
+            sh = fc.signed_header
+            pubkeys, msgs, sigs, powers = fc.validators.collect_commit_sigs(
+                fx.chain_id, sh.commit.block_id, fc.height, sh.commit
+            )
+            vrow, prow, j = [], [], 0
+            for p in sh.commit.precommits:
+                if p is None:
+                    vrow.append(None)
+                    prow.append(0)
+                else:
+                    vrow.append((pubkeys[j].bytes(), msgs[j], sigs[j]))
+                    prow.append(powers[j])
+                    j += 1
+            votes_rows.append(vrow)
+            power_rows.append(prow)
+            totals.append(fc.validators.total_voting_power())
+
+        win = cv.pack_commit_window(votes_rows, power_rows)
+        ok_hv, tally, _ = cv.verify_commit_window(win, max(totals))
+        for i, fc in enumerate(fcs):
+            keys = {v.pub_key.bytes(): v.pub_key for v in fc.validators.validators}
+            for v, item in enumerate(votes_rows[i]):
+                if item is None:
+                    continue
+                pub, msg, sig = item
+                assert bool(ok_hv[i, v]) == keys[pub].verify_bytes(msg, sig), (
+                    f"device/host disagree at ({i},{v})"
+                )
+        assert not bool(ok_hv[2, 1])  # the tampered one
+
+    def test_tampered_signature_rejected_like_host(self, fx):
+        fcs = _window(fx, 6, 9)
+        pc = fcs[1].signed_header.commit.precommits[0]
+        fcs[1].signed_header.commit.precommits[0] = dataclasses.replace(
+            pc, signature=b"\x11" * 64
+        )
+        with pytest.raises(_SnapshotRejected, match="invalid signature"):
+            _syncer_for(fx)._verify_backfill_window(fcs)
+        sh = fcs[1].signed_header
+        with pytest.raises(CommitError, match="invalid signature"):
+            fcs[1].validators.verify_commit(
+                fx.chain_id, sh.commit.block_id, fcs[1].height, sh.commit
+            )
+
+    def test_insufficient_power_rejected_like_host(self, fx):
+        fcs = _window(fx, 6, 9)
+        # 2 of 4 equal-power validators is not > 2/3
+        fcs[2].signed_header.commit.precommits[0] = None
+        fcs[2].signed_header.commit.precommits[1] = None
+        with pytest.raises(_SnapshotRejected, match="voting power"):
+            _syncer_for(fx)._verify_backfill_window(fcs)
+        sh = fcs[2].signed_header
+        with pytest.raises(CommitError, match="voting power"):
+            fcs[2].validators.verify_commit(
+                fx.chain_id, sh.commit.block_id, fcs[2].height, sh.commit
+            )
+
+    def test_empty_window_rejected(self, fx):
+        with pytest.raises(_SnapshotRejected):
+            _syncer_for(fx)._verify_backfill_window([])
+
+
+# ---------------------------------------------------------------------------
+# end-to-end restore
+# ---------------------------------------------------------------------------
+
+
+class _CorruptingStore:
+    """SnapshotStore wrapper that serves flipped chunk bytes — an adversarial
+    peer whose every chunk fails the manifest check."""
+
+    def __init__(self, inner):
+        self._inner = inner
+
+    def list(self, limit=10):
+        return self._inner.list(limit)
+
+    def load_chunk(self, height, format, index):
+        c = self._inner.load_chunk(height, format, index)
+        if c is None:
+            return None
+        return bytes(b ^ 0xFF for b in c) or b"\xff"
+
+
+class _HubPeer:
+    """Peer handle as seen from one switch; try_send delivers to the remote
+    reactor on its own thread (the real recv thread does the same)."""
+
+    def __init__(self, peer_id):
+        self.id = peer_id
+        self._deliver = None
+
+    def try_send(self, chan_id, raw):
+        threading.Thread(
+            target=self._deliver, args=(chan_id, raw), daemon=True
+        ).start()
+        return True
+
+    send = try_send
+
+
+class _HubSwitch:
+    """In-process stand-in for Switch wiring (SecretConnection needs the
+    'cryptography' package, absent in some CI environments): the same
+    peers.list/get, broadcast and stop_peer_for_error surface the statesync
+    reactor drives, with thread-per-message delivery."""
+
+    def __init__(self, name):
+        self.id = name
+        self.reactors = {}
+        self._peers = {}
+        self.peers = self  # .list() / .get() live on the switch itself
+
+    def list(self):
+        return list(self._peers.values())
+
+    def get(self, peer_id):
+        return self._peers.get(peer_id)
+
+    def add_reactor(self, name, reactor):
+        self.reactors[name] = reactor
+        reactor.set_switch(self)
+
+    def broadcast(self, chan_id, raw):
+        for p in self.list():
+            p.try_send(chan_id, raw)
+
+    def stop_peer_for_error(self, peer, reason):
+        if self._peers.pop(peer.id, None) is not None:
+            for r in self.reactors.values():
+                r.remove_peer(peer, reason)
+
+    def _dispatch(self, chan_id, from_peer, raw):
+        for r in self.reactors.values():
+            r.receive(chan_id, from_peer, raw)
+
+
+def _hub_connect(a, b):
+    peer_b, peer_a = _HubPeer(b.id), _HubPeer(a.id)
+    peer_b._deliver = lambda chan, raw: b._dispatch(chan, peer_a, raw)
+    peer_a._deliver = lambda chan, raw: a._dispatch(chan, peer_b, raw)
+    a._peers[b.id] = peer_b
+    b._peers[a.id] = peer_a
+    for r in a.reactors.values():
+        r.add_peer(peer_b)
+    for r in b.reactors.values():
+        r.add_peer(peer_a)
+
+
+def _hub_net(named_reactors):
+    """Fully meshed fake switches, one (name, reactor) each, all started."""
+    switches = []
+    for name, reactor in named_reactors:
+        sw = _HubSwitch(name)
+        sw.add_reactor("statesync", reactor)
+        switches.append(sw)
+    for r_name, reactor in named_reactors:
+        reactor.start()
+    for i in range(len(switches)):
+        for j in range(i + 1, len(switches)):
+            _hub_connect(switches[i], switches[j])
+    return switches
+
+
+class TestStateSyncEndToEnd:
+    def test_restore_rejects_corrupt_chunk_verifies_and_backfills(
+        self, monkeypatch
+    ):
+        from tendermint_tpu.parallel import commit_verify as cv
+
+        # producer chain: snapshots at heights 4, 8, 12; height 13 exists so
+        # header(13) carries the trusted app hash for the height-12 snapshot
+        snap_store = SnapshotStore(MemDB())
+
+        def app_factory():
+            app = PersistentKVStoreApp()
+            app.configure_snapshots(snap_store, 4, chunk_size=48)
+            return app
+
+        fx = build_chain(
+            n_vals=4, n_heights=13, chain_id="ss-e2e", txs_per_block=3,
+            app_factory=app_factory,
+        )
+        snap = snap_store.get(12, chunker.SNAPSHOT_FORMAT)
+        assert snap is not None and snap.chunks >= 2  # round-robin hits both peers
+
+        # the restoring node
+        app2 = PersistentKVStoreApp()
+        conn2 = MultiAppConn(LocalClientCreator(app2))
+        conn2.start()
+        state_db2, block_store2 = MemDB(), BlockStore(MemDB())
+        cfg = StateSyncConfig(
+            enable=True,
+            trust_height=1,
+            trust_hash=fx.block_store.load_block_meta(1).header.hash().hex(),
+            discovery_time=0.25,
+            chunk_fetch_timeout=5.0,
+            chunk_retries=4,
+            backfill_blocks=4,
+        )
+        metrics = StateSyncMetrics()
+        syncer = StateSyncer(
+            cfg, fx.chain_id, fx.genesis, conn2.query, state_db2, block_store2,
+            metrics=metrics,
+        )
+        synced = []
+        client = StateSyncReactor(
+            cfg, app_query=conn2.query, block_store=block_store2,
+            state_db=state_db2, syncer=syncer,
+            on_synced=lambda st, h: synced.append(st), metrics=metrics,
+        )
+
+        serve_cfg = StateSyncConfig()
+        good = StateSyncReactor(
+            serve_cfg, snapshot_store=snap_store,
+            block_store=fx.block_store, state_db=fx.state_db,
+        )
+        evil = StateSyncReactor(
+            serve_cfg, snapshot_store=_CorruptingStore(snap_store),
+            block_store=fx.block_store, state_db=fx.state_db,
+        )
+
+        # count backfill dispatches: the whole trailing window must be ONE
+        # batched device call
+        dispatches = []
+        orig = cv.verify_commit_window
+
+        def counting(win, total_power, mesh=None):
+            dispatches.append(win.shape)
+            return orig(win, total_power, mesh=mesh)
+
+        monkeypatch.setattr(cv, "verify_commit_window", counting)
+
+        evil_id = "peer-evil"
+        _hub_net([("peer-client", client), ("peer-good", good), (evil_id, evil)])
+        try:
+            assert wait_for(lambda: synced, timeout=60), client.progress()
+            state = synced[0]
+
+            # the evil peer's corrupt chunk was caught and the peer banned;
+            # every chunk was then re-requested from the honest peer
+            assert evil_id in client._banned
+            assert metrics.chunk_fetch._values.get(("bad",), 0) >= 1
+            assert metrics.chunk_fetch._values.get(("ok",), 0) >= snap.chunks
+
+            # restored state == what a fast-synced node computes from genesis
+            expected = self._fast_sync_state(fx, 12)
+            assert state.last_block_height == 12
+            assert state.chain_id == fx.chain_id
+            assert state.last_block_id == expected.last_block_id
+            assert state.app_hash == expected.app_hash
+            assert state.last_results_hash == expected.last_results_hash
+            assert state.validators.hash() == expected.validators.hash()
+            assert (
+                state.next_validators.hash() == expected.next_validators.hash()
+            )
+            assert state.last_validators.hash() == expected.last_validators.hash()
+            assert state.last_block_time_ns == expected.last_block_time_ns
+            assert state.last_block_total_tx == expected.last_block_total_tx
+            # ... and against the light-client-verified header directly
+            meta13 = fx.block_store.load_block_meta(13)
+            assert state.app_hash == meta13.header.app_hash
+
+            # restored app state: exact snapshot blob, verified app hash
+            assert app2.height == 12
+            info = conn2.query.info_sync(abci.RequestInfo())
+            assert info.last_block_height == 12
+            assert info.last_block_app_hash == meta13.header.app_hash
+
+            # backfill window [9..12]: ONE batched (H, V) dispatch
+            assert dispatches == [(4, 4)]
+            assert block_store2.height() == 12 and block_store2.base() == 9
+            assert block_store2.load_seen_commit(12) is not None
+            for h in range(9, 13):
+                assert block_store2.load_block_meta(h) is not None
+                assert block_store2.load_block_commit(h) is not None
+
+            # the restored state DB serves validators for the window + H+1
+            for h in range(9, 14):
+                assert sm_store.load_validators(state_db2, h).hash() == (
+                    fx.state.validators.hash()
+                )
+            reloaded = sm_store.load_state(state_db2)
+            assert reloaded.last_block_height == 12
+            assert reloaded.app_hash == state.app_hash
+
+            # reactor reports the finished sync
+            prog = client.progress()
+            assert prog["synced_height"] == 12
+            assert prog["syncing"] is False
+            assert prog["chunks_applied"] == snap.chunks
+        finally:
+            for r in (client, good, evil):
+                r.stop()
+
+    def _fast_sync_state(self, fx, upto):
+        """Replay the chain through a fresh executor — the state a fast-synced
+        node would reach at `upto`."""
+        from tendermint_tpu.state.execution import BlockExecutor
+        from tendermint_tpu.types import BlockID
+
+        st = state_from_genesis(fx.genesis)
+        db = MemDB()
+        sm_store.save_state(db, st)
+        conn = MultiAppConn(LocalClientCreator(PersistentKVStoreApp()))
+        conn.start()
+        block_exec = BlockExecutor(db, conn.consensus)
+        for h in range(1, upto + 1):
+            block = fx.block_store.load_block(h)
+            parts = block.make_part_set()
+            block_id = BlockID(hash=block.hash(), parts_header=parts.header())
+            st = block_exec.apply_block(
+                st, block_id, block, trusted_last_commit=True
+            )
+        return st
+
+    def test_bad_trust_root_is_fatal(self):
+        """A configured trust hash the network disagrees with must abort the
+        restore, not fall through to the next snapshot."""
+        snap_store = SnapshotStore(MemDB())
+
+        def app_factory():
+            app = PersistentKVStoreApp()
+            app.configure_snapshots(snap_store, 4, chunk_size=48)
+            return app
+
+        fx = build_chain(
+            n_vals=2, n_heights=9, chain_id="ss-badroot", txs_per_block=1,
+            app_factory=app_factory,
+        )
+        app2 = PersistentKVStoreApp()
+        conn2 = MultiAppConn(LocalClientCreator(app2))
+        conn2.start()
+        cfg = StateSyncConfig(
+            enable=True, trust_height=1, trust_hash="ab" * 32,
+            discovery_time=0.2, chunk_fetch_timeout=3.0,
+        )
+        syncer = StateSyncer(
+            cfg, fx.chain_id, fx.genesis, conn2.query, MemDB(),
+            BlockStore(MemDB()), metrics=StateSyncMetrics(),
+        )
+        client = StateSyncReactor(
+            cfg, app_query=conn2.query, syncer=syncer,
+            metrics=StateSyncMetrics(),
+        )
+        server = StateSyncReactor(
+            StateSyncConfig(), snapshot_store=snap_store,
+            block_store=fx.block_store, state_db=fx.state_db,
+        )
+        _hub_net([("peer-client", client), ("peer-server", server)])
+        try:
+            assert wait_for(
+                lambda: client._sync_error is not None, timeout=30
+            ), client.progress()
+            assert "trust root mismatch" in client._sync_error
+            assert app2.height == 0  # no chunk ever reached the app
+        finally:
+            client.stop()
+            server.stop()
