@@ -3,8 +3,8 @@
 PYTHON ?= python
 
 .PHONY: test test_slow test_sanitizers bench bench-local bench_fastsync \
-        bench_secp bench_multisig metrics-lint statesync-smoke \
-        localnet-start localnet-stop build-docker-localnode
+        planner-bench bench_secp bench_multisig metrics-lint \
+        statesync-smoke localnet-start localnet-stop build-docker-localnode
 
 test:
 	$(PYTHON) -m pytest tests/ -q
@@ -26,6 +26,10 @@ bench-local:
 
 bench_fastsync:
 	$(PYTHON) scripts/bench_fastsync.py 2048 64 512
+
+# verification-planner occupancy/throughput on the ragged valset workload
+planner-bench:
+	JAX_PLATFORMS=cpu $(PYTHON) scripts/bench_fastsync.py --ragged-valsets
 
 bench_secp:
 	$(PYTHON) scripts/bench_secp.py 1024

@@ -296,6 +296,8 @@ def _self_check():
     vm.host_fallback.add(1.0, ("no_tpu",))
     vm.speculative.add(3.0, ("hit",))
     vm.window_heights.observe(512.0)
+    vm.record_planner(680, 1024, compiled=True)
+    vm.record_planner(680, 1024)
 
     nm = NodeMetrics()
 

@@ -121,10 +121,12 @@ def main():
     # 1. producer chain with snapshots at heights 4, 8, 12 (height 13 exists
     # so header(13) carries the trusted app hash for the height-12 snapshot)
     snap_store = SnapshotStore(MemDB())
+    producer_apps = []
 
     def app_factory():
         app = PersistentKVStoreApp()
         app.configure_snapshots(snap_store, 4, chunk_size=48)
+        producer_apps.append(app)
         return app
 
     print("building 13-height producer chain ...")
@@ -132,6 +134,8 @@ def main():
         n_vals=4, n_heights=13, chain_id="ss-smoke", txs_per_block=3,
         app_factory=app_factory,
     )
+    for app in producer_apps:
+        app.wait_snapshots()  # production is async off the commit thread
     snap = snap_store.get(12, chunker.SNAPSHOT_FORMAT)
     _check(snap is not None and snap.chunks >= 2, "producer published a multi-chunk snapshot at height 12")
 

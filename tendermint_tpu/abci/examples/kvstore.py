@@ -12,7 +12,9 @@ from __future__ import annotations
 
 import base64
 import json
+import queue
 import struct
+import threading
 from typing import Dict, List, Optional
 
 from tendermint_tpu.abci import types as abci
@@ -107,6 +109,10 @@ class PersistentKVStoreApp(KVStoreApp):
         self._snapshot_interval = 0
         self._snapshot_chunk_size = 65536
         self._snapshot_keep_recent = 3
+        # snapshot production runs on a background worker so commit() —
+        # the consensus thread — never pays for chunking + store writes
+        self._snap_queue: Optional["queue.Queue"] = None
+        self._snap_thread: Optional[threading.Thread] = None
         # restore in progress: (Snapshot, expected chunk hashes, chunks so far)
         self._restoring: Optional[tuple] = None
         self._load()
@@ -183,11 +189,48 @@ class PersistentKVStoreApp(KVStoreApp):
         keep_recent: int = 3,
     ) -> None:
         """Enable snapshot production: every `interval` heights, chunk the
-        persisted state blob into `store` (a statesync.SnapshotStore)."""
+        persisted state blob into `store` (a statesync.SnapshotStore).
+        Chunking and store writes happen on a daemon worker thread;
+        commit() only enqueues the (height, blob) pair — see ROADMAP
+        "snapshot production is synchronous in commit()"."""
         self._snapshot_store = store
         self._snapshot_interval = interval
         self._snapshot_chunk_size = chunk_size
         self._snapshot_keep_recent = keep_recent
+        if self._snap_thread is None:
+            self._snap_queue = queue.Queue()
+            self._snap_thread = threading.Thread(
+                target=self._snapshot_worker, name="kvstore-snapshot",
+                daemon=True,
+            )
+            self._snap_thread.start()
+
+    def _snapshot_worker(self) -> None:
+        from tendermint_tpu.libs import trace
+        from tendermint_tpu.statesync import chunker
+
+        while True:
+            height, blob = self._snap_queue.get()
+            try:
+                with trace.span(
+                    "statesync.snapshot_produce", height=height,
+                    size=len(blob),
+                ):
+                    snap, chunks = chunker.make_snapshot(
+                        height, blob, self._snapshot_chunk_size
+                    )
+                    self._snapshot_store.save(snap, chunks)
+                    self._snapshot_store.prune(self._snapshot_keep_recent)
+            except Exception:
+                pass  # a failed snapshot must never wedge the worker
+            finally:
+                self._snap_queue.task_done()
+
+    def wait_snapshots(self) -> None:
+        """Block until every enqueued snapshot has been produced (tests,
+        orderly shutdown)."""
+        if self._snap_queue is not None:
+            self._snap_queue.join()
 
     def _state_blob(self) -> bytes:
         # the exact bytes _save persists — a restore round-trips through
@@ -201,13 +244,9 @@ class PersistentKVStoreApp(KVStoreApp):
             or self.height % self._snapshot_interval != 0
         ):
             return
-        from tendermint_tpu.statesync.chunker import make_snapshot
-
-        snap, chunks = make_snapshot(
-            self.height, self._state_blob(), self._snapshot_chunk_size
-        )
-        self._snapshot_store.save(snap, chunks)
-        self._snapshot_store.prune(self._snapshot_keep_recent)
+        # snapshot the committed blob NOW (later commits mutate the db);
+        # chunking + store writes happen on the worker thread
+        self._snap_queue.put((self.height, self._state_blob()))
 
     def list_snapshots(
         self, req: abci.RequestListSnapshots

@@ -4,11 +4,11 @@ verification (ref: blockchain/reactor.go:216-327).
 The reference's pool routine peeks TWO blocks and serially verifies one
 commit per iteration (reactor.go:289-306 — ★ THE loop this framework exists
 to replace). Here the pool yields a whole run of consecutive blocks and all
-their commits are verified in ONE BatchVerifier dispatch — every
+their commits are verified in ONE planned dispatch — every
 (height, validator) signature of the window in a single device call
-(`verify_block_window`), with quorum tallies in numpy. The mesh-sharded
-variant of the same math lives in parallel/commit_verify.py and is exercised
-by the multi-chip dryrun.
+(`verify_block_window`).  Packing, dispatch, and the +2/3 quorum tallies
+live in parallel/planner.py (lane-packed, compile-bucketed), shared with
+state sync's backfill; with a mesh the lane axis shards across devices.
 
 Verified blocks then apply sequentially with ``trusted_last_commit=True`` so
 the executor does not re-verify signatures the window already covered.
@@ -21,8 +21,6 @@ import time
 from concurrent.futures import CancelledError, Future
 from typing import List, Optional, Tuple
 
-import numpy as np
-
 from tendermint_tpu.blockchain.messages import (
     BlockRequestMessage,
     BlockResponseMessage,
@@ -33,7 +31,6 @@ from tendermint_tpu.blockchain.messages import (
     unmarshal_msg,
 )
 from tendermint_tpu.blockchain.pool import BlockPool
-from tendermint_tpu.crypto.batch import verify_generic
 from tendermint_tpu.libs import trace
 from tendermint_tpu.libs.metrics import get_verify_metrics
 from tendermint_tpu.p2p.base_reactor import Reactor
@@ -92,13 +89,16 @@ def verify_block_window(
     — reactor.go:306's VerifyCommit, across the whole window at once).
 
     Per-precommit validity rules + power collection are shared with the
-    single-commit path (ValidatorSet.collect_commit_sigs) so the two
+    single-commit path (ValidatorSet.collect_commit_sigs); packing, verify
+    dispatch, and the +2/3 quorum tallies all live in `parallel/planner` —
+    the ONE implementation shared with state sync's backfill, so the
     verifiers cannot drift apart.
 
-    With ``mesh`` (and an all-ed25519 valset) the window dispatches through
-    parallel/commit_verify: the (heights × validators) signature tensor is
-    sharded over the 2-D device mesh and the quorum tallies ride the mesh as
-    reductions — the multi-chip path of SURVEY §5.
+    Without a mesh the planner routes lanes through the BatchVerifier
+    boundary (ed25519 rides the device batch; other key types fall back to
+    host inside verify_generic).  With ``mesh`` (and an all-ed25519 valset)
+    the window's votes are lane-packed and the quorum tallies ride the mesh
+    as segment reductions — the multi-chip path of SURVEY §5.
 
     Returns (n_verified, err): the first n_verified blocks' commits are
     fully verified; err is set if block n_verified is *invalid* (vs merely
@@ -106,6 +106,7 @@ def verify_block_window(
     If `parts_out` is given, it receives each usable block's PartSet so the
     apply loop doesn't rebuild it (block marshal + merkle per block).
     """
+    from tendermint_tpu.parallel import planner
     from tendermint_tpu.types.validator_set import CommitError
 
     valset = state.validators
@@ -114,17 +115,12 @@ def verify_block_window(
     if n <= 0:
         return 0, None
 
-    if mesh is not None:
-        return _verify_window_sharded(state, blocks, mesh, parts_out, verifier)
-
     # 1. host prechecks + truncation at the first valset change
     usable = 0
     structural: Optional[WindowVerifyError] = None
-    all_pubkeys: List = []
-    all_msgs: List[bytes] = []
-    all_sigs: List[bytes] = []
-    # per-height bookkeeping: (start offset, power vector)
-    spans: List[Tuple[int, List[int]]] = []
+    votes_rows: List[list] = []
+    power_rows: List[list] = []
+    local_parts: List = []
     for i in range(n):
         block, next_block = blocks[i], blocks[i + 1]
         if block.header.validators_hash != valset.hash():
@@ -138,95 +134,17 @@ def verify_block_window(
         parts = block.make_part_set()
         block_id = BlockID(hash=block.hash(), parts_header=parts.header())
         try:
-            pubkeys, msgs, sigs, powers = valset.collect_commit_sigs(
-                chain_id, block_id, block.height, commit
-            )
-        except CommitError as e:
-            structural = WindowVerifyError(i, str(e))
-            break
-        start = len(all_pubkeys)
-        all_pubkeys.extend(pubkeys)
-        all_msgs.extend(msgs)
-        all_sigs.extend(sigs)
-        spans.append((start, powers))
-        if parts_out is not None:
-            parts_out.append(parts)
-        usable += 1
-
-    if usable == 0:
-        return 0, structural
-
-    # 2. ONE batched dispatch for the whole window (ed25519 rides the device;
-    # other key types fall back to host inside verify_generic)
-    ok = verify_generic(all_pubkeys, all_msgs, all_sigs, verifier=verifier)
-
-    # 3. per-height quorum tallies; stop at the first invalid commit
-    quorum_bar = valset.total_voting_power() * 2
-    for i in range(usable):
-        start, powers = spans[i]
-        sl = ok[start : start + len(powers)]
-        if not bool(np.all(sl)):
-            if parts_out is not None:
-                del parts_out[i:]
-            return i, WindowVerifyError(i, "invalid signature in commit")
-        if int(np.dot(sl, np.asarray(powers, dtype=np.int64))) * 3 <= quorum_bar:
-            if parts_out is not None:
-                del parts_out[i:]
-            return i, WindowVerifyError(i, "insufficient voting power")
-    return usable, structural
-
-
-def _verify_window_sharded(
-    state, blocks: List, mesh, parts_out: Optional[List], verifier=None
-) -> Tuple[int, Optional[WindowVerifyError]]:
-    """The mesh path: pack a (heights × validators) tensor and verify+tally
-    it through parallel/commit_verify (ed25519 valsets; a mixed-key set
-    falls back to the flat batch, keeping the caller's verifier)."""
-    from tendermint_tpu.crypto.keys import PubKeyEd25519
-    from tendermint_tpu.parallel import commit_verify as cv
-    from tendermint_tpu.types.validator_set import CommitError
-
-    valset = state.validators
-    chain_id = state.chain_id
-    n = len(blocks) - 1
-    if any(not isinstance(v.pub_key, PubKeyEd25519) for v in valset.validators):
-        return verify_block_window(
-            state, blocks, verifier=verifier, parts_out=parts_out
-        )
-
-    usable = 0
-    structural: Optional[WindowVerifyError] = None
-    votes_rows: List[list] = []
-    power_rows: List[list] = []
-    local_parts: List = []
-    for i in range(n):
-        block, next_block = blocks[i], blocks[i + 1]
-        if block.header.validators_hash != valset.hash():
-            if i == 0:
-                structural = WindowVerifyError(0, "wrong validators_hash")
-            break
-        commit = next_block.last_commit
-        parts = block.make_part_set()
-        block_id = BlockID(hash=block.hash(), parts_header=parts.header())
-        try:
             # the ONE home of the per-precommit rules; its aligned outputs
-            # (non-nil precommits in index order) are reused below
+            # (non-nil precommits in index order) feed the planner row
             pubkeys, msgs, sigs, powers = valset.collect_commit_sigs(
                 chain_id, block_id, block.height, commit
             )
         except CommitError as e:
             structural = WindowVerifyError(i, str(e))
             break
-        vrow, prow = [], []
-        j = 0
-        for pc in commit.precommits:
-            if pc is None:
-                vrow.append(None)
-                prow.append(0)
-            else:
-                vrow.append((pubkeys[j].bytes(), msgs[j], sigs[j]))
-                prow.append(powers[j])
-                j += 1
+        vrow, prow = planner.rows_from_commit(
+            commit.precommits, pubkeys, msgs, sigs, powers
+        )
         votes_rows.append(vrow)
         power_rows.append(prow)
         local_parts.append(parts)
@@ -235,22 +153,24 @@ def _verify_window_sharded(
     if usable == 0:
         return 0, structural
 
-    win = cv.pack_commit_window(votes_rows, power_rows)
-    ok_hv, _tally, committed = cv.verify_commit_window(
-        win, valset.total_voting_power(), mesh=mesh
+    # 2. ONE planned dispatch for the whole window; quorum math lives in
+    # the planner's WindowVerdict (mixed-key valsets fall back to the
+    # verifier path inside execute_plan, keeping the caller's verifier)
+    total = valset.total_voting_power()
+    verdict = planner.verify_window(
+        votes_rows, power_rows, [total] * usable,
+        mesh=mesh, verifier=verifier, use_device=mesh is not None,
     )
-    present_vote = np.zeros(win.shape, dtype=bool)
-    for h, row in enumerate(votes_rows):
-        for v, item in enumerate(row):
-            present_vote[h, v] = item is not None
+
+    # 3. translate the per-height verdict; stop at the first invalid commit
     for i in range(usable):
-        # any invalid signature fails the whole commit (verify_commit parity);
-        # win.present excludes host-precheck failures, which are failures too
-        if bool((present_vote[i] & ~ok_hv[i]).any()):
+        # any invalid signature fails the whole commit (verify_commit
+        # parity) — sigs_ok already counts host-precheck failures as bad
+        if not bool(verdict.sigs_ok[i]):
             if parts_out is not None:
                 parts_out.extend(local_parts[:i])
             return i, WindowVerifyError(i, "invalid signature in commit")
-        if not bool(committed[i]):
+        if not bool(verdict.committed[i]):
             if parts_out is not None:
                 parts_out.extend(local_parts[:i])
             return i, WindowVerifyError(i, "insufficient voting power")

@@ -321,6 +321,22 @@ class VerifyMetrics:
             "verify_window_heights", "Heights per fast-sync verify window",
             buckets=tuple(float(1 << i) for i in range(11)),
         )
+        # verification planner (parallel/planner.py): ragged lane packing
+        self.lane_occupancy = r.histogram(
+            "verify_lane_occupancy",
+            "Present lanes / dispatched lanes per planner dispatch",
+            buckets=(0.05, 0.1, 0.2, 0.3, 0.4, 0.5, 0.6, 0.7, 0.8, 0.9, 1.0),
+        )
+        self.lanes = r.counter(
+            "verify_lanes_total",
+            "Planner device lanes dispatched by kind (present|padded)",
+            label_names=("kind",),
+        )
+        self.planner_bucket = r.counter(
+            "verify_planner_bucket_total",
+            "Planner (lane, segment) bucket lookups by event (hit|compile)",
+            label_names=("event",),
+        )
 
     def record_dispatch(self, backend: str, algo: str, n: int,
                         seconds: float, rejects: int = 0,
@@ -335,6 +351,16 @@ class VerifyMetrics:
         self.sigs.add(float(n), (backend, algo))
         if rejects:
             self.rejects.add(float(rejects), (backend, algo))
+
+    def record_planner(self, present: int, dispatched: int,
+                       compiled: bool = False) -> None:
+        """One planner device dispatch: lane occupancy (present vs padded)
+        and the compile-cache outcome for its (lane, segment) bucket."""
+        if dispatched > 0:
+            self.lane_occupancy.observe(present / dispatched)
+            self.lanes.add(float(present), ("present",))
+            self.lanes.add(float(dispatched - present), ("padded",))
+        self.planner_bucket.add(1.0, ("compile" if compiled else "hit",))
 
 
 _verify_mtx = threading.Lock()

@@ -80,7 +80,7 @@ def pack_commit_window(
         power=z((H, V), np.int64),
     )
     # flatten present votes and run the shared host prologue once
-    coords, pubs_l, msgs_l, sigs_l = [], [], [], []
+    coords, pubs_l, msgs_l, sigs_l, pows_l = [], [], [], [], []
     for h, row in enumerate(votes):
         for v, item in enumerate(row):
             if item is None:
@@ -92,6 +92,7 @@ def pack_commit_window(
             pubs_l.append(bytes(pub))
             msgs_l.append(bytes(msg))
             sigs_l.append(bytes(sig))
+            pows_l.append(powers[h][v])
     if coords:
         n = len(coords)
         pubs = np.frombuffer(b"".join(pubs_l), np.uint8).reshape(n, 32)
@@ -99,8 +100,8 @@ def pack_commit_window(
         neg_ax, ay, s_words, h_words, r_limbs, r_sign, valid = _k.host_prologue(
             pubs, msgs_l, sigs
         )
-        hs = np.array([c[0] for c in coords])
-        vs = np.array([c[1] for c in coords])
+        hv = np.asarray(coords, dtype=np.int64)
+        hs, vs = hv[:, 0], hv[:, 1]
         win.neg_ax[hs, vs] = neg_ax
         win.ay[hs, vs] = ay
         win.s_words[hs, vs] = s_words
@@ -108,9 +109,9 @@ def pack_commit_window(
         win.r_limbs[hs, vs] = r_limbs
         win.r_sign[hs, vs] = r_sign
         win.present[hs, vs] = valid
-        for j, (h, v) in enumerate(coords):
-            if valid[j]:
-                win.power[h, v] = powers[h][v]
+        win.power[hs, vs] = np.where(
+            valid, np.asarray(pows_l, dtype=np.int64), 0
+        )
     return win
 
 
@@ -125,6 +126,9 @@ def _step(neg_ax, ay, s_words, h_words, r_limbs, r_sign, present, power, total_p
 
 
 _step_cache = {}
+# jit re-traces per padded shape even under a cached mesh key; track
+# (mesh, padded_shape) so compile-latency histograms stay honest
+_compiled_shapes = set()
 
 
 def _compiled_step(mesh):
@@ -184,7 +188,9 @@ def verify_commit_window(
     # consensus-safety bug.  Scope the flag to this dispatch instead of
     # flipping global dtype semantics for the whole process at import time.
     backend = "window_mesh" if mesh is not None else "window"
-    first = mesh not in _step_cache
+    shape_key = (mesh, (ph, pv))
+    first = shape_key not in _compiled_shapes
+    _compiled_shapes.add(shape_key)
     n = int(np.count_nonzero(win.present))
     t0 = time.perf_counter()
     with trace.span("verify.window_dispatch", backend=backend, H=H, V=V, n=n):

@@ -1,0 +1,547 @@
+"""Verification planner — ragged lane packing, bucketed compile cache, and a
+double-buffered window pipeline.
+
+Every window verifier in the tree ("verify the commits of H heights, each
+with its own valset") routes through this module:
+
+  * `blockchain/reactor.verify_block_window` — fast sync, flat and mesh;
+  * `statesync/syncer._verify_backfill_window` — the trailing backfill;
+  * `scripts/bench_fastsync.py --ragged-valsets` — the occupancy bench.
+
+Why it exists: `parallel/commit_verify` packs a window into a dense
+``(H, V)`` grid where ``V`` is the *largest* valset in the window.  On
+ragged workloads (a backfill crossing valset changes, a chain mixing a
+4-validator appchain epoch with a 100-validator epoch) most of that grid is
+padding — lanes the device still pays full ladder cost for.  The planner
+instead flattens the window into a 1-D *lane* tensor holding only real
+votes, carrying a per-lane ``segment_id`` (the height each lane belongs
+to), so the per-height quorum tally is a branch-free ``segment_sum``
+instead of a ``val``-axis reduction over mostly-padding lanes.
+
+Three mechanisms, one per class of waste:
+
+  1. **Ragged lane packing** (`plan_window`): bin-pack every height's
+     present votes into one lane axis; occupancy = Σ_h V_h / bucket(Σ V_h)
+     instead of Σ_h V_h / (H × max_h V_h).
+  2. **Shape-bucketed compilation** (`_compiled_step`): lanes pad to a
+     power-of-two bucket (64..4096, then multiples of 4096 — the same
+     ladder as `ops/ed25519_verify._bucket`) and segments to a power-of-two
+     ≥ 8, so the jit step compiles once per ``(mesh, lane_bucket,
+     seg_bucket)`` instead of once per window shape.  `compile_count()`
+     exposes the exact number of compiles for tests and benches.
+  3. **Double-buffered dispatch** (`WindowPipeline`): the host prologue
+     (SHA-512 of sign-bytes, point decompression, limb packing) for window
+     N+1 runs on a worker thread while window N's device dispatch is in
+     flight — JAX dispatch is async and the prologue is numpy/hashlib work
+     that releases the GIL, so the two genuinely overlap (`planner.pack` /
+     `planner.dispatch` trace spans make the overlap visible).
+
+Quorum semantics are the ONE shared implementation (`WindowVerdict`):
+``committed[h] = tally[h] * 3 > totals[h] * 2`` (strict — an exact 2/3
+tally must NOT commit) and ``sigs_ok[h]`` = no present vote of height h
+failed verification (verify_commit parity: any invalid signature fails the
+whole commit).  Callers translate the verdict into their own error types;
+no quorum math lives in the callers anymore.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from dataclasses import dataclass
+from typing import Iterable, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from tendermint_tpu.libs import trace
+from tendermint_tpu.libs.metrics import get_verify_metrics
+
+# (pubkey: PubKey object or raw 32-byte ed25519 key, msg, sig) or None
+SigTuple = Tuple[object, bytes, bytes]
+
+MIN_LANES = 64  # smallest lane bucket (matches ops/ed25519_verify._bucket)
+MAX_POW2_LANES = 4096  # above this, buckets are multiples of 4096
+MIN_SEGS = 8  # smallest segment (height) bucket
+
+
+def lanes_bucket(n: int, mesh=None) -> int:
+    """Lane pad size: powers of two 64..4096, then multiples of 4096; with a
+    mesh, rounded up to a multiple of the device count so the lane axis
+    shards evenly."""
+    b = MIN_LANES
+    while b < n and b < MAX_POW2_LANES:
+        b *= 2
+    if n > b:
+        b = ((n + MAX_POW2_LANES - 1) // MAX_POW2_LANES) * MAX_POW2_LANES
+    if mesh is not None:
+        nd = int(mesh.devices.size)
+        if b % nd:
+            b = ((b + nd - 1) // nd) * nd
+    return b
+
+
+def segs_bucket(h: int) -> int:
+    """Segment (height) pad size: power of two ≥ MIN_SEGS."""
+    b = MIN_SEGS
+    while b < h:
+        b *= 2
+    return b
+
+
+def _pub_bytes(pk) -> bytes:
+    """Raw key bytes for device packing: PubKey objects expose .bytes()."""
+    b = getattr(pk, "bytes", None)
+    return b() if callable(b) else bytes(pk)
+
+
+@dataclass
+class WindowPlan:
+    """A ragged window flattened to lanes.  `coords[j] = (h, v)` maps lane j
+    back to its grid cell; `seg_ids[j] = h` feeds the segment tallies.
+    Malformed votes (wrong sig/pub length, undecompressable key) keep their
+    lane — they must count as *failures*, not absences — with
+    ``wellformed[j] = False``."""
+
+    H: int
+    V: int  # widest row (the ok-grid width)
+    coords: np.ndarray  # (n, 2) int32
+    seg_ids: np.ndarray  # (n,) int32, sorted ascending
+    pubs: list  # lane pubkeys (PubKey objects or raw bytes)
+    msgs: list
+    sigs: list
+    powers: np.ndarray  # (n,) int64
+    wellformed: np.ndarray  # (n,) bool
+    totals: np.ndarray  # (H,) int64 per-height total voting power
+    dev: Optional[tuple] = None  # padded device tensors (pack_device)
+    dev_shape: Optional[Tuple[int, int]] = None  # (lane bucket, seg bucket)
+
+    @property
+    def n_lanes(self) -> int:
+        return len(self.pubs)
+
+    def all_ed25519(self) -> bool:
+        """True when every lane can ride the ed25519 device kernel (raw
+        32-byte keys or PubKeyEd25519 objects; malformed lanes are handled
+        host-side either way)."""
+        from tendermint_tpu.crypto.keys import PubKey, PubKeyEd25519
+
+        for pk in self.pubs:
+            if isinstance(pk, PubKey) and not isinstance(pk, PubKeyEd25519):
+                return False
+        return True
+
+
+@dataclass
+class WindowVerdict:
+    """Per-height outcome of one planned window — the single home of the
+    quorum math shared by fast sync, state sync, and the benches."""
+
+    ok: np.ndarray  # (H, V) bool — per-vote verdict grid
+    tally: np.ndarray  # (H,) int64 — voting power of valid signatures
+    committed: np.ndarray  # (H,) bool — tally*3 > total*2 (STRICT)
+    sigs_ok: np.ndarray  # (H,) bool — no present vote failed
+    lanes_present: int  # real votes dispatched
+    lanes_dispatched: int  # lanes after bucket padding (0 for host path)
+
+    @property
+    def occupancy(self) -> float:
+        if self.lanes_dispatched <= 0:
+            return 1.0
+        return self.lanes_present / self.lanes_dispatched
+
+
+def plan_window(
+    votes: Sequence[Sequence[Optional[SigTuple]]],
+    powers: Sequence[Sequence[int]],
+    totals: Sequence[int],
+) -> WindowPlan:
+    """Flatten ragged (height, valset) rows into lanes.  ``votes[h][v]`` is
+    ``(pub, msg, sig)`` or None (absent/nil); ``powers[h][v]`` the voting
+    power; ``totals[h]`` the height's total power (valsets may differ per
+    height — state sync's backfill crosses valset changes)."""
+    H = len(votes)
+    if len(totals) != H or len(powers) != H:
+        raise ValueError("votes, powers and totals must have one row per height")
+    V = max((len(row) for row in votes), default=0)
+    coords: List[Tuple[int, int]] = []
+    pubs, msgs, sigs = [], [], []
+    pw: List[int] = []
+    wf: List[bool] = []
+    for h, row in enumerate(votes):
+        prow = powers[h]
+        for v, item in enumerate(row):
+            if item is None:
+                continue
+            pub, msg, sig = item
+            coords.append((h, v))
+            pubs.append(pub)
+            msgs.append(bytes(msg))
+            sigs.append(bytes(sig))
+            pw.append(prow[v])
+            wf.append(len(sig) == 64 and len(_pub_bytes(pub)) == 32)
+    n = len(coords)
+    coords_a = (
+        np.asarray(coords, dtype=np.int32)
+        if n
+        else np.zeros((0, 2), dtype=np.int32)
+    )
+    return WindowPlan(
+        H=H,
+        V=V,
+        coords=coords_a,
+        seg_ids=np.ascontiguousarray(coords_a[:, 0]),
+        pubs=pubs,
+        msgs=msgs,
+        sigs=sigs,
+        powers=np.asarray(pw, dtype=np.int64),
+        wellformed=np.asarray(wf, dtype=bool),
+        totals=np.asarray(list(totals), dtype=np.int64),
+    )
+
+
+def pack_device(plan: WindowPlan, mesh=None) -> WindowPlan:
+    """Host prologue for the device path: SHA-512 + decompress + limb-pack
+    every wellformed lane, padded to the (lane, segment) bucket.  This is
+    the expensive host work `WindowPipeline` overlaps with dispatch."""
+    from tendermint_tpu.ops import ed25519_verify as _k
+
+    if plan.dev is not None:
+        return plan
+    n = plan.n_lanes
+    B = lanes_bucket(n, mesh)
+    S = segs_bucket(plan.H)
+    z = np.zeros
+    neg_ax = z((B, _k.NLIMB), np.uint32)
+    ay = z((B, _k.NLIMB), np.uint32)
+    s_words = z((B, 8), np.uint32)
+    h_words = z((B, 8), np.uint32)
+    r_limbs = z((B, _k.NLIMB), np.uint32)
+    r_sign = z((B,), np.uint32)
+    present = z((B,), bool)
+    is_vote = z((B,), bool)
+    power = z((B,), np.int64)
+    seg_ids = z((B,), np.int32)
+    if n:
+        is_vote[:n] = True
+        seg_ids[:n] = plan.seg_ids
+        idx = np.flatnonzero(plan.wellformed)
+        if idx.size:
+            pubs_a = np.frombuffer(
+                b"".join(_pub_bytes(plan.pubs[j]) for j in idx), np.uint8
+            ).reshape(idx.size, 32)
+            sigs_a = np.frombuffer(
+                b"".join(plan.sigs[j] for j in idx), np.uint8
+            ).reshape(idx.size, 64)
+            msgs_l = [plan.msgs[j] for j in idx]
+            nax, a_y, s_w, h_w, r_l, r_s, valid = _k.host_prologue(
+                pubs_a, msgs_l, sigs_a
+            )
+            neg_ax[idx] = nax
+            ay[idx] = a_y
+            s_words[idx] = s_w
+            h_words[idx] = h_w
+            r_limbs[idx] = r_l
+            r_sign[idx] = r_s
+            present[idx] = valid
+        power[:n] = np.where(present[:n], plan.powers, 0)
+    totals = np.zeros((S,), np.int64)
+    totals[: plan.H] = plan.totals
+    plan.dev = (
+        neg_ax, ay, s_words, h_words, r_limbs, r_sign,
+        present, is_vote, power, seg_ids, totals,
+    )
+    plan.dev_shape = (B, S)
+    return plan
+
+
+# ---------------------------------------------------------------------------
+# The bucketed device step
+# ---------------------------------------------------------------------------
+
+
+def _planner_step(
+    neg_ax, ay, s_words, h_words, r_limbs, r_sign,
+    present, is_vote, power, seg_ids, totals,
+):
+    """One lane-packed verify + segment-tally step.  The quorum tally is a
+    segment-sum over the lane axis (sorted segment ids), so a height's
+    tally costs its own lanes — not the widest valset's."""
+    import jax
+    import jax.numpy as jnp
+
+    from tendermint_tpu.ops import ed25519_verify as _k
+
+    raw = _k._verify_kernel(neg_ax, ay, s_words, h_words, r_limbs, r_sign)
+    ok = raw & present
+    S = totals.shape[0]
+    tally = jax.ops.segment_sum(
+        jnp.where(ok, power, jnp.zeros_like(power)), seg_ids,
+        num_segments=S, indices_are_sorted=True,
+    )
+    nbad = jax.ops.segment_sum(
+        (is_vote & ~ok).astype(jnp.int32), seg_ids,
+        num_segments=S, indices_are_sorted=True,
+    )
+    committed = tally * 3 > totals * 2
+    return ok, tally, committed, nbad
+
+
+_step_cache: dict = {}
+_compiles = 0
+_cache_mtx = threading.Lock()
+
+
+def compile_count() -> int:
+    """Planner step compiles since process start / last reset_cache() —
+    the honest compile counter the bucket design is judged by."""
+    return _compiles
+
+
+def reset_cache() -> None:
+    """Drop the compiled-step cache and zero the compile counter (tests)."""
+    global _compiles
+    with _cache_mtx:
+        _step_cache.clear()
+        _compiles = 0
+
+
+def _compiled_step(mesh, B: int, S: int):
+    """jit'd step for one (mesh, lane bucket, seg bucket); returns
+    (fn, compiled) where compiled marks a cache miss (a real jit trace —
+    padded shapes are fixed per bucket, so key miss == recompile)."""
+    global _compiles
+    import jax
+
+    key = (mesh, B, S)
+    with _cache_mtx:
+        fn = _step_cache.get(key)
+        if fn is not None:
+            return fn, False
+        if mesh is None:
+            fn = jax.jit(_planner_step)
+        else:
+            from jax.sharding import NamedSharding, PartitionSpec as PS
+
+            # lanes shard over EVERY mesh axis (the planner's lane axis is
+            # the product of the caller's height × val axes); the small
+            # per-segment outputs replicate
+            lane = NamedSharding(mesh, PS(tuple(mesh.axis_names)))
+            rep = NamedSharding(mesh, PS())
+            fn = jax.jit(
+                _planner_step,
+                in_shardings=(lane,) * 10 + (rep,),
+                out_shardings=(lane, rep, rep, rep),
+            )
+        _step_cache[key] = fn
+        _compiles += 1
+        return fn, True
+
+
+def _execute_device(plan: WindowPlan, mesh=None) -> WindowVerdict:
+    from tendermint_tpu.parallel.commit_verify import _enable_x64
+
+    pack_device(plan, mesh)
+    B, S = plan.dev_shape
+    n = plan.n_lanes
+    fn, compiled = _compiled_step(mesh, B, S)
+    t0 = time.perf_counter()
+    backend = "planner_mesh" if mesh is not None else "planner"
+    with trace.span(
+        "planner.dispatch", backend=backend, H=plan.H, lanes=B, n=n,
+        compiled=compiled,
+    ):
+        # int64 powers: same consensus-safety reasoning as commit_verify —
+        # without x64 the tally silently wraps at 2^31
+        with _enable_x64(True):
+            arrs = plan.dev
+            if mesh is not None:
+                import jax
+                from jax.sharding import NamedSharding, PartitionSpec as PS
+
+                lane = NamedSharding(mesh, PS(tuple(mesh.axis_names)))
+                rep = NamedSharding(mesh, PS())
+                arrs = [jax.device_put(a, lane) for a in arrs[:-1]] + [
+                    jax.device_put(arrs[-1], rep)
+                ]
+            ok_l, tally, committed, nbad = fn(*arrs)
+            ok_l = np.asarray(ok_l)[:n]
+            tally = np.asarray(tally)[: plan.H]
+            committed = np.asarray(committed)[: plan.H]
+            nbad = np.asarray(nbad)[: plan.H]
+    try:
+        m = get_verify_metrics()
+        m.record_planner(n, B, compiled=compiled)
+        # rejects = lanes that passed the host prechecks but failed the
+        # device verify (same definition as commit_verify)
+        m.record_dispatch(
+            backend, "ed25519", n, time.perf_counter() - t0,
+            rejects=int(np.count_nonzero(plan.dev[6][:n] & ~ok_l)),
+            first=compiled,
+        )
+    except Exception:
+        pass
+    ok = np.zeros((plan.H, plan.V), dtype=bool)
+    if n:
+        ok[plan.coords[:, 0], plan.coords[:, 1]] = ok_l
+    return WindowVerdict(
+        ok=ok,
+        tally=tally.astype(np.int64, copy=False),
+        committed=committed,
+        sigs_ok=nbad == 0,
+        lanes_present=n,
+        lanes_dispatched=B,
+    )
+
+
+def _execute_host(plan: WindowPlan, verifier=None) -> WindowVerdict:
+    """Lane verification through the BatchVerifier boundary (verify_generic
+    — mixed key types, custom verifiers, the process default backend), with
+    the SAME segment tallies in numpy.  int64 throughout: np.bincount would
+    round powers through float64."""
+    from tendermint_tpu.crypto.batch import verify_generic
+    from tendermint_tpu.crypto.keys import PubKey, PubKeyEd25519
+
+    n = plan.n_lanes
+    ok_l = np.zeros((n,), dtype=bool)
+    if n:
+        idx = np.flatnonzero(plan.wellformed)
+        if idx.size:
+            pub_objs = []
+            for j in idx:
+                pk = plan.pubs[j]
+                if not isinstance(pk, PubKey):
+                    pk = PubKeyEd25519(bytes(pk))
+                pub_objs.append(pk)
+            ok_l[idx] = verify_generic(
+                pub_objs,
+                [plan.msgs[j] for j in idx],
+                [plan.sigs[j] for j in idx],
+                verifier=verifier,
+            )
+    tally = np.zeros((plan.H,), dtype=np.int64)
+    nbad = np.zeros((plan.H,), dtype=np.int64)
+    if n:
+        np.add.at(tally, plan.seg_ids[ok_l], plan.powers[ok_l])
+        np.add.at(nbad, plan.seg_ids[~ok_l], 1)
+    ok = np.zeros((plan.H, plan.V), dtype=bool)
+    if n:
+        ok[plan.coords[:, 0], plan.coords[:, 1]] = ok_l
+    return WindowVerdict(
+        ok=ok,
+        tally=tally,
+        committed=tally * 3 > plan.totals * 2,
+        sigs_ok=nbad == 0,
+        lanes_present=n,
+        lanes_dispatched=0,
+    )
+
+
+def execute_plan(
+    plan: WindowPlan, mesh=None, verifier=None, use_device: Optional[bool] = None
+) -> WindowVerdict:
+    """Run a planned window.  use_device None → device iff a mesh was given;
+    True routes the jit lane kernel (falling back to the verifier path when
+    a lane's key type can't ride it); False goes through the BatchVerifier
+    boundary (which itself may be a device backend — pallas in production)."""
+    if use_device is None:
+        use_device = mesh is not None
+    if use_device and plan.all_ed25519():
+        return _execute_device(plan, mesh=mesh)
+    return _execute_host(plan, verifier=verifier)
+
+
+def verify_window(
+    votes: Sequence[Sequence[Optional[SigTuple]]],
+    powers: Sequence[Sequence[int]],
+    totals: Sequence[int],
+    mesh=None,
+    verifier=None,
+    use_device: Optional[bool] = None,
+) -> WindowVerdict:
+    """plan + execute in one call — the synchronous entry point."""
+    with trace.span("planner.pack", H=len(votes)):
+        plan = plan_window(votes, powers, totals)
+        if (use_device or (use_device is None and mesh is not None)) and (
+            plan.all_ed25519()
+        ):
+            pack_device(plan, mesh)
+    return execute_plan(plan, mesh=mesh, verifier=verifier, use_device=use_device)
+
+
+def rows_from_commit(precommits, pubkeys, msgs, sigs, powers):
+    """Adapt `ValidatorSet.collect_commit_sigs` outputs (aligned, non-nil
+    precommits in index order) into one planner row — shared by fast sync
+    and state sync so the two can never drift."""
+    vrow: List[Optional[SigTuple]] = []
+    prow: List[int] = []
+    j = 0
+    for pc in precommits:
+        if pc is None:
+            vrow.append(None)
+            prow.append(0)
+        else:
+            vrow.append((pubkeys[j], msgs[j], sigs[j]))
+            prow.append(powers[j])
+            j += 1
+    return vrow, prow
+
+
+# ---------------------------------------------------------------------------
+# Double-buffered window pipeline
+# ---------------------------------------------------------------------------
+
+
+class WindowPipeline:
+    """Overlap host packing with device dispatch across a stream of windows.
+
+    A daemon worker thread runs `plan_window` + `pack_device` (SHA-512,
+    point decompression, limb packing — the measured host slice) for window
+    N+1 while the consumer's dispatch for window N is in flight; a bounded
+    queue keeps at most `prefetch` packed windows in memory.  Exceptions
+    from the spec iterator or the packer re-raise at the consuming side, in
+    order, so callers keep their normal error handling."""
+
+    def __init__(self, mesh=None, verifier=None,
+                 use_device: Optional[bool] = None, prefetch: int = 2):
+        self.mesh = mesh
+        self.verifier = verifier
+        self.use_device = use_device
+        self.prefetch = max(1, prefetch)
+
+    def run(
+        self, specs: Iterable[Tuple[Sequence, Sequence, Sequence]]
+    ) -> Iterator[WindowVerdict]:
+        q: "queue.Queue" = queue.Queue(maxsize=self.prefetch)
+        use_device = self.use_device
+        mesh = self.mesh
+
+        def worker():
+            try:
+                for votes, powers, totals in specs:
+                    with trace.span("planner.pack", H=len(votes)):
+                        plan = plan_window(votes, powers, totals)
+                        dev = use_device if use_device is not None else (
+                            mesh is not None
+                        )
+                        if dev and plan.all_ed25519():
+                            pack_device(plan, mesh)
+                    q.put(("plan", plan))
+            except BaseException as e:  # re-raised on the consumer side
+                q.put(("err", e))
+            else:
+                q.put(("done", None))
+
+        threading.Thread(
+            target=worker, name="planner-pack", daemon=True
+        ).start()
+        while True:
+            kind, item = q.get()
+            if kind == "done":
+                return
+            if kind == "err":
+                raise item
+            yield execute_plan(
+                item, mesh=mesh, verifier=self.verifier,
+                use_device=use_device,
+            )

@@ -3,13 +3,15 @@
   discover → pick snapshot → light-client trust (lite/verifier against the
   configured trust root) → ABCI offer/apply chunk handshake → app-hash check
   against the light-client-verified header → TPU-batched backfill of the
-  trailing commit window (ONE parallel/commit_verify dispatch) → persist
-  blocks/validators/state → hand the reconstructed sm.State to fast sync.
+  trailing commit window (lane-packed `parallel/planner` sub-windows with a
+  double-buffered pack→dispatch pipeline) → persist blocks/validators/state
+  → hand the reconstructed sm.State to fast sync.
 
 The trailing window exists because a restored node must still serve
 LastCommit to consensus (reconstruct_last_commit) and recent blocks to
-peers; its (H, V) signature tensor is exactly the fast-sync window shape, so
-the whole backfill is one device dispatch instead of per-height loops.
+peers; its ragged (height, valset) rows are exactly the fast-sync window
+shape, so the backfill shares fast sync's planner instead of per-height
+loops.
 """
 
 from __future__ import annotations
@@ -17,8 +19,6 @@ from __future__ import annotations
 import logging
 import time
 from typing import Dict, List, Optional, Set, Tuple
-
-import numpy as np
 
 from tendermint_tpu.abci import types as abci
 from tendermint_tpu.libs import trace
@@ -346,74 +346,67 @@ class StateSyncer:
         self.metrics.backfill_heights.observe(float(len(fcs)))
         return fcs
 
+    # heights per planner sub-window: small enough that the pipeline's
+    # worker thread keeps packing N+1 while N's dispatch is in flight,
+    # large enough to fill lane buckets across ragged valsets
+    BACKFILL_SUBWINDOW = 32
+
     def _verify_backfill_window(self, fcs: List[FullCommit]) -> None:
-        """Every (height, validator) signature of the window in ONE
-        parallel/commit_verify dispatch; per-height +2/3 quorum host-side
-        against each height's own total power (valsets can differ across the
-        window, so the scalar-total device quorum is not used)."""
-        from tendermint_tpu.crypto.keys import PubKeyEd25519
-        from tendermint_tpu.parallel import commit_verify as cv
+        """Backfill commits through `parallel/planner`: ragged valsets
+        across the window lane-pack into bucketed tiles with each height
+        tallied against ITS OWN total power (valsets can differ across the
+        window), and `WindowPipeline` overlaps host packing of sub-window
+        N+1 with the device dispatch of N.  Quorum math lives in the
+        planner's WindowVerdict — shared with fast sync's
+        verify_block_window.  Mixed-key valsets fall back to the
+        BatchVerifier path inside the planner, same acceptance rules."""
+        from tendermint_tpu.parallel import planner
 
         if not fcs:
             raise _SnapshotRejected("empty backfill window")
-        if any(
-            not isinstance(v.pub_key, PubKeyEd25519)
-            for fc in fcs
-            for v in fc.validators.validators
-        ):
-            # mixed-key valset: host path per height (device tensor is
-            # ed25519-only); same acceptance rules
-            for fc in fcs:
-                sh = fc.signed_header
-                fc.validators.verify_commit(
-                    self.chain_id, sh.commit.block_id, fc.height, sh.commit,
-                    verifier=self.batch_verifier,
-                )
-            return
 
-        votes_rows, power_rows, totals = [], [], []
-        for fc in fcs:
-            sh = fc.signed_header
-            try:
-                pubkeys, msgs, sigs, powers = fc.validators.collect_commit_sigs(
-                    self.chain_id, sh.commit.block_id, fc.height, sh.commit
-                )
-            except CommitError as e:
-                raise _SnapshotRejected(
-                    f"bad backfill commit at {fc.height}: {e}"
-                )
-            vrow, prow = [], []
-            j = 0
-            for pc in sh.commit.precommits:
-                if pc is None:
-                    vrow.append(None)
-                    prow.append(0)
-                else:
-                    vrow.append((pubkeys[j].bytes(), msgs[j], sigs[j]))
-                    prow.append(powers[j])
-                    j += 1
-            votes_rows.append(vrow)
-            power_rows.append(prow)
-            totals.append(fc.validators.total_voting_power())
+        def specs():
+            for s in range(0, len(fcs), self.BACKFILL_SUBWINDOW):
+                sub = fcs[s : s + self.BACKFILL_SUBWINDOW]
+                votes_rows, power_rows, totals = [], [], []
+                for fc in sub:
+                    sh = fc.signed_header
+                    try:
+                        pubkeys, msgs, sigs, powers = (
+                            fc.validators.collect_commit_sigs(
+                                self.chain_id, sh.commit.block_id,
+                                fc.height, sh.commit,
+                            )
+                        )
+                    except CommitError as e:
+                        raise _SnapshotRejected(
+                            f"bad backfill commit at {fc.height}: {e}"
+                        )
+                    vrow, prow = planner.rows_from_commit(
+                        sh.commit.precommits, pubkeys, msgs, sigs, powers
+                    )
+                    votes_rows.append(vrow)
+                    power_rows.append(prow)
+                    totals.append(fc.validators.total_voting_power())
+                yield votes_rows, power_rows, totals
 
-        win = cv.pack_commit_window(votes_rows, power_rows)
-        ok_hv, tally, _ = cv.verify_commit_window(
-            win, max(totals), mesh=self.mesh
+        pipe = planner.WindowPipeline(
+            mesh=self.mesh, verifier=self.batch_verifier, use_device=True
         )
-        present = np.zeros(win.shape, dtype=bool)
-        for h, row in enumerate(votes_rows):
-            for v, item in enumerate(row):
-                present[h, v] = item is not None
-        for i, fc in enumerate(fcs):
-            if bool((present[i] & ~ok_hv[i]).any()):
-                raise _SnapshotRejected(
-                    f"invalid signature in backfill commit at {fc.height}"
-                )
-            if int(tally[i]) * 3 <= totals[i] * 2:
-                raise _SnapshotRejected(
-                    f"insufficient voting power in backfill commit at "
-                    f"{fc.height}"
-                )
+        off = 0
+        for verdict in pipe.run(specs()):
+            sub = fcs[off : off + len(verdict.committed)]
+            for i, fc in enumerate(sub):
+                if not bool(verdict.sigs_ok[i]):
+                    raise _SnapshotRejected(
+                        f"invalid signature in backfill commit at {fc.height}"
+                    )
+                if not bool(verdict.committed[i]):
+                    raise _SnapshotRejected(
+                        f"insufficient voting power in backfill commit at "
+                        f"{fc.height}"
+                    )
+            off += len(sub)
 
     def _persist_backfill(self, fcs: List[FullCommit]) -> None:
         from tendermint_tpu.blockchain.store import BlockMeta

@@ -1,0 +1,324 @@
+"""Verification planner: ragged lane packing, bucketed compile cache, and
+the double-buffered window pipeline (parallel/planner.py)."""
+
+import time
+
+import numpy as np
+import pytest
+
+
+def _signed(n, tag=0):
+    """n deterministic (pub32, msg, sig) triples."""
+    from tendermint_tpu.crypto import ed25519 as ed
+
+    out = []
+    for i in range(n):
+        seed = bytes([(i % 251) + 1, (i // 251) + 1, (tag % 250) + 1]) * 16
+        priv = ed.gen_privkey(seed[:32])
+        msg = b"planner-%d-%d" % (tag, i)
+        out.append((priv[32:], msg, ed.sign(priv, msg)))
+    return out
+
+
+def _ragged_window(sizes, absent=(), forged=(), malformed=(), tag=0):
+    """votes/powers/totals rows for per-height valset sizes, with lanes
+    mutated by (h, v) coordinate sets."""
+    triples = _signed(sum(sizes), tag=tag)
+    votes, powers, totals = [], [], []
+    i = 0
+    for h, V in enumerate(sizes):
+        vrow, prow = [], []
+        for v in range(V):
+            pub, msg, sig = triples[i]
+            i += 1
+            if (h, v) in absent:
+                vrow.append(None)
+            elif (h, v) in forged:
+                bad = bytearray(sig)
+                bad[7] ^= 1
+                vrow.append((pub, msg, bytes(bad)))
+            elif (h, v) in malformed:
+                vrow.append((pub, msg, sig[:63]))  # wrong sig length
+            else:
+                vrow.append((pub, msg, sig))
+            prow.append((h + v) % 9 + 1)
+        votes.append(vrow)
+        powers.append(prow)
+        totals.append(sum(prow))
+    return votes, powers, totals
+
+
+def _reference(votes, powers, totals):
+    """The per-height host verifier the planner must match bit-exactly:
+    one ed25519.verify per present vote, int64 tallies, strict +2/3."""
+    from tendermint_tpu.crypto import ed25519 as ed
+
+    H = len(votes)
+    V = max((len(r) for r in votes), default=0)
+    ok = np.zeros((H, V), dtype=bool)
+    tally = np.zeros(H, dtype=np.int64)
+    sigs_ok = np.ones(H, dtype=bool)
+    for h, row in enumerate(votes):
+        for v, item in enumerate(row):
+            if item is None:
+                continue
+            pub, msg, sig = item
+            good = (
+                len(sig) == 64
+                and len(pub) == 32
+                and ed.verify(bytes(pub), msg, sig)
+            )
+            ok[h, v] = good
+            if good:
+                tally[h] += powers[h][v]
+            else:
+                sigs_ok[h] = False
+    committed = tally * 3 > np.asarray(totals, dtype=np.int64) * 2
+    return ok, tally, committed, sigs_ok
+
+
+def _assert_verdict_matches(verdict, votes, powers, totals):
+    ok, tally, committed, sigs_ok = _reference(votes, powers, totals)
+    assert np.array_equal(verdict.ok, ok)
+    assert verdict.tally.dtype == np.int64
+    assert np.array_equal(verdict.tally, tally)
+    assert np.array_equal(verdict.committed, committed)
+    assert np.array_equal(verdict.sigs_ok, sigs_ok)
+
+
+class TestPlannerExactness:
+    @pytest.mark.parametrize("use_device", [False, True])
+    def test_ragged_window_bit_exact(self, use_device):
+        from tendermint_tpu.parallel import planner
+
+        votes, powers, totals = _ragged_window(
+            [1, 4, 16, 64, 3, 7],
+            absent={(1, 2), (3, 10), (5, 0)},
+            forged={(3, 3), (4, 1)},
+            malformed={(3, 40)},
+            tag=1,
+        )
+        verdict = planner.verify_window(
+            votes, powers, totals, use_device=use_device
+        )
+        _assert_verdict_matches(verdict, votes, powers, totals)
+        # a forged/malformed signature fails its whole commit
+        assert not verdict.sigs_ok[3] and not verdict.sigs_ok[4]
+
+    @pytest.mark.parametrize("use_device", [False, True])
+    def test_mixed_sizes_1_4_64(self, use_device):
+        from tendermint_tpu.parallel import planner
+
+        votes, powers, totals = _ragged_window([1, 4, 64], tag=2)
+        verdict = planner.verify_window(
+            votes, powers, totals, use_device=use_device
+        )
+        _assert_verdict_matches(verdict, votes, powers, totals)
+        assert verdict.committed.all()  # all sigs valid → every height commits
+
+    @pytest.mark.parametrize("use_device", [False, True])
+    def test_quorum_boundary_exact_two_thirds_must_not_commit(
+        self, use_device
+    ):
+        """tally * 3 == total * 2 is NOT +2/3 — strict inequality."""
+        from tendermint_tpu.parallel import planner
+
+        votes, powers, _ = _ragged_window([3], tag=3)
+        powers = [[1, 1, 1]]
+        # 2 valid votes of power 1 against total 3: tally*3 = 6 == total*2
+        votes[0][2] = None
+        verdict = planner.verify_window(
+            votes, powers, [3], use_device=use_device
+        )
+        assert int(verdict.tally[0]) == 2
+        assert not bool(verdict.committed[0])
+        assert bool(verdict.sigs_ok[0])
+        # one more unit of power crosses the boundary
+        verdict2 = planner.verify_window(
+            votes, [[2, 1, 1]], [3], use_device=use_device
+        )
+        assert int(verdict2.tally[0]) == 3
+        assert bool(verdict2.committed[0])
+
+    @pytest.mark.parametrize("use_device", [False, True])
+    def test_all_absent_height(self, use_device):
+        from tendermint_tpu.parallel import planner
+
+        votes, powers, totals = _ragged_window([4, 4], tag=4)
+        votes[1] = [None] * 4
+        verdict = planner.verify_window(
+            votes, powers, totals, use_device=use_device
+        )
+        _assert_verdict_matches(verdict, votes, powers, totals)
+        assert int(verdict.tally[1]) == 0
+        assert not bool(verdict.committed[1])
+        assert bool(verdict.sigs_ok[1])  # absence is not a bad signature
+
+    @pytest.mark.parametrize("use_device", [False, True])
+    def test_int64_powers_do_not_wrap(self, use_device):
+        from tendermint_tpu.parallel import planner
+
+        votes, _, _ = _ragged_window([3], tag=5)
+        big = 3_000_000_000  # > 2^31
+        verdict = planner.verify_window(
+            votes, [[big, big, big]], [3 * big], use_device=use_device
+        )
+        assert verdict.tally.tolist() == [3 * big]
+        assert verdict.committed.tolist() == [True]
+
+
+class TestPlannerBuckets:
+    def test_one_compile_per_bucket(self):
+        """Windows of differing (H, V) that land in the same (lane, seg)
+        bucket must trigger exactly one jit compile."""
+        from tendermint_tpu.parallel import planner
+
+        planner.reset_cache()
+        # all ≤ 64 lanes and ≤ 8 heights → one (64, 8) bucket
+        for tag, sizes in enumerate([[1, 4], [16, 3, 2], [8] * 8, [40]]):
+            votes, powers, totals = _ragged_window(sizes, tag=10 + tag)
+            planner.verify_window(votes, powers, totals, use_device=True)
+        assert planner.compile_count() == 1
+        # 65+ lanes cross into the 128 bucket: exactly one more compile
+        votes, powers, totals = _ragged_window([40, 40], tag=20)
+        planner.verify_window(votes, powers, totals, use_device=True)
+        assert planner.compile_count() == 2
+
+    def test_occupancy_at_least_2x_grid_packing(self):
+        """The acceptance workload: 32 heights, sizes cycling {1,4,16,64}.
+        Lane occupancy must be ≥ 2× the dense (H × max V) grid packing."""
+        from tendermint_tpu.parallel import planner
+
+        sizes = [1, 4, 16, 64] * 8
+        votes, powers, totals = _ragged_window(sizes, tag=30)
+        verdict = planner.verify_window(votes, powers, totals, use_device=True)
+        present = sum(sizes)
+        assert verdict.lanes_present == present
+        assert verdict.lanes_dispatched == planner.lanes_bucket(present)
+        grid_occ = present / (len(sizes) * max(sizes))
+        assert verdict.occupancy >= 2 * grid_occ
+
+    def test_lanes_bucket_ladder(self):
+        from tendermint_tpu.parallel import planner
+
+        assert planner.lanes_bucket(1) == 64
+        assert planner.lanes_bucket(64) == 64
+        assert planner.lanes_bucket(65) == 128
+        assert planner.lanes_bucket(4096) == 4096
+        assert planner.lanes_bucket(4097) == 8192
+        assert planner.lanes_bucket(8193) == 12288  # multiples of 4096 above
+        assert planner.segs_bucket(1) == 8
+        assert planner.segs_bucket(9) == 16
+
+
+class TestWindowPipeline:
+    def test_pipeline_matches_serial(self):
+        from tendermint_tpu.parallel import planner
+
+        specs = [
+            _ragged_window([1, 4], tag=40),
+            _ragged_window([16, 2, 64], forged={(1, 1)}, tag=41),
+            _ragged_window([8], absent={(0, 3)}, tag=42),
+        ]
+        pipe = planner.WindowPipeline(use_device=True, prefetch=2)
+        verdicts = list(pipe.run(iter(specs)))
+        assert len(verdicts) == len(specs)
+        for verdict, (votes, powers, totals) in zip(verdicts, specs):
+            _assert_verdict_matches(verdict, votes, powers, totals)
+
+    def test_pipeline_propagates_spec_errors_in_order(self):
+        from tendermint_tpu.parallel import planner
+
+        good = _ragged_window([2], tag=43)
+
+        def specs():
+            yield good
+            raise RuntimeError("spec construction failed")
+
+        pipe = planner.WindowPipeline(use_device=False)
+        it = pipe.run(specs())
+        first = next(it)
+        _assert_verdict_matches(first, *good)
+        with pytest.raises(RuntimeError, match="spec construction failed"):
+            next(it)
+
+
+class TestCommitVerifyCompileDetection:
+    def test_first_dispatch_keys_on_shape_not_just_mesh(self, monkeypatch):
+        """Regression: `first = mesh not in _step_cache` reported only the
+        first shape ever as a compile; jit re-traces per padded shape."""
+        from tendermint_tpu.parallel import commit_verify as cv
+
+        firsts = []
+
+        class _Rec:
+            def record_dispatch(self, *a, **kw):
+                firsts.append(kw.get("first"))
+
+        monkeypatch.setattr(cv, "get_verify_metrics", lambda: _Rec())
+        monkeypatch.setattr(cv, "_compiled_shapes", set())
+
+        def win(H, V, tag):
+            votes, powers, _ = _ragged_window([V] * H, tag=tag)
+            return cv.pack_commit_window(votes, powers)
+
+        cv.verify_commit_window(win(2, 3, 50), total_power=100)
+        cv.verify_commit_window(win(2, 3, 51), total_power=100)
+        cv.verify_commit_window(win(4, 5, 52), total_power=100)  # new shape
+        cv.verify_commit_window(win(4, 5, 53), total_power=100)
+        assert firsts == [True, False, True, False]
+
+
+class TestPackCommitWindowVectorized:
+    def test_power_scatter_matches_validity(self):
+        """Vectorized fancy-index packing: power lands only on lanes that
+        pass host prechecks (incl. undecompressable pubkeys)."""
+        from tendermint_tpu.crypto import ed25519 as ed
+        from tendermint_tpu.parallel import commit_verify as cv
+
+        votes, powers, _ = _ragged_window([4, 4], tag=60)
+        votes[0][1] = None  # absent: power 0
+        pub, msg, sig = votes[1][2]
+        bad_pub = next(  # smallest y with no curve point (not a QR)
+            bytes([b]) + bytes(31)
+            for b in range(256)
+            if ed._decompress_xy(bytes([b]) + bytes(31)) is None
+        )
+        votes[1][2] = (bad_pub, msg, sig)
+        win = cv.pack_commit_window(votes, powers)
+        want_power = np.asarray(powers, dtype=np.int64)
+        want_power[0, 1] = 0
+        want_power[1, 2] = 0
+        assert np.array_equal(win.power, want_power)
+        assert not win.present[0, 1] and not win.present[1, 2]
+
+
+class TestAsyncSnapshotProduction:
+    def test_commit_latency_excludes_chunking(self, monkeypatch):
+        """commit() must only enqueue; a slow make_snapshot runs on the
+        worker thread and wait_snapshots() observes its result."""
+        from tendermint_tpu.abci import types as abci
+        from tendermint_tpu.abci.examples.kvstore import PersistentKVStoreApp
+        from tendermint_tpu.libs.db.kv import MemDB
+        from tendermint_tpu.statesync import chunker
+        from tendermint_tpu.statesync.store import SnapshotStore
+
+        real = chunker.make_snapshot
+
+        def slow_make_snapshot(height, blob, chunk_size):
+            time.sleep(0.4)
+            return real(height, blob, chunk_size)
+
+        monkeypatch.setattr(chunker, "make_snapshot", slow_make_snapshot)
+        app = PersistentKVStoreApp()
+        store = SnapshotStore(MemDB())
+        app.configure_snapshots(store, interval=1, chunk_size=32)
+        app.begin_block(abci.RequestBeginBlock())
+        assert app.deliver_tx(abci.RequestDeliverTx(tx=b"a=b")).code == 0
+        app.end_block(abci.RequestEndBlock())
+        t0 = time.perf_counter()
+        app.commit(abci.RequestCommit())
+        commit_dt = time.perf_counter() - t0
+        assert commit_dt < 0.2, f"commit() paid for chunking ({commit_dt:.3f}s)"
+        app.wait_snapshots()
+        assert [s.height for s in store.list()] == [1]

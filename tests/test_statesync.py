@@ -182,6 +182,7 @@ class TestKVStoreSnapshotHandshake:
             app, 1, heights,
             lambda h: [b"k%d-%d=v%d" % (h, j, h) for j in range(3)],
         )
+        app.wait_snapshots()  # production is async off the commit thread
         return app, store
 
     def test_producer_snapshots_at_interval(self):
@@ -196,6 +197,7 @@ class TestKVStoreSnapshotHandshake:
         store = SnapshotStore(MemDB())
         app.configure_snapshots(store, 2, keep_recent=2)
         _run_blocks(app, 1, 10, lambda h: [b"a%d=b" % h])
+        app.wait_snapshots()
         assert [s.height for s in store.list()] == [10, 8]
 
     def test_restore_round_trip_with_corrupt_chunk_retry(self):
@@ -538,21 +540,23 @@ class TestStateSyncEndToEnd:
     def test_restore_rejects_corrupt_chunk_verifies_and_backfills(
         self, monkeypatch
     ):
-        from tendermint_tpu.parallel import commit_verify as cv
-
         # producer chain: snapshots at heights 4, 8, 12; height 13 exists so
         # header(13) carries the trusted app hash for the height-12 snapshot
         snap_store = SnapshotStore(MemDB())
+        producer_apps = []
 
         def app_factory():
             app = PersistentKVStoreApp()
             app.configure_snapshots(snap_store, 4, chunk_size=48)
+            producer_apps.append(app)
             return app
 
         fx = build_chain(
             n_vals=4, n_heights=13, chain_id="ss-e2e", txs_per_block=3,
             app_factory=app_factory,
         )
+        for app in producer_apps:
+            app.wait_snapshots()  # production is async off the commit thread
         snap = snap_store.get(12, chunker.SNAPSHOT_FORMAT)
         assert snap is not None and snap.chunks >= 2  # round-robin hits both peers
 
@@ -593,15 +597,17 @@ class TestStateSyncEndToEnd:
         )
 
         # count backfill dispatches: the whole trailing window must be ONE
-        # batched device call
+        # planned batch (planner sub-windows hold up to 32 heights)
+        from tendermint_tpu.parallel import planner
+
         dispatches = []
-        orig = cv.verify_commit_window
+        orig = planner.execute_plan
 
-        def counting(win, total_power, mesh=None):
-            dispatches.append(win.shape)
-            return orig(win, total_power, mesh=mesh)
+        def counting(plan, **kw):
+            dispatches.append((plan.H, plan.V))
+            return orig(plan, **kw)
 
-        monkeypatch.setattr(cv, "verify_commit_window", counting)
+        monkeypatch.setattr(planner, "execute_plan", counting)
 
         evil_id = "peer-evil"
         _hub_net([("peer-client", client), ("peer-good", good), (evil_id, evil)])
@@ -690,16 +696,20 @@ class TestStateSyncEndToEnd:
         """A configured trust hash the network disagrees with must abort the
         restore, not fall through to the next snapshot."""
         snap_store = SnapshotStore(MemDB())
+        producer_apps = []
 
         def app_factory():
             app = PersistentKVStoreApp()
             app.configure_snapshots(snap_store, 4, chunk_size=48)
+            producer_apps.append(app)
             return app
 
         fx = build_chain(
             n_vals=2, n_heights=9, chain_id="ss-badroot", txs_per_block=1,
             app_factory=app_factory,
         )
+        for app in producer_apps:
+            app.wait_snapshots()
         app2 = PersistentKVStoreApp()
         conn2 = MultiAppConn(LocalClientCreator(app2))
         conn2.start()
