@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import base64
 import json
+import logging
 import queue
 import struct
 import threading
@@ -113,6 +114,9 @@ class PersistentKVStoreApp(KVStoreApp):
         # the consensus thread — never pays for chunking + store writes
         self._snap_queue: Optional["queue.Queue"] = None
         self._snap_thread: Optional[threading.Thread] = None
+        # chronic production failures (disk full, store bug) must be
+        # visible: each is logged and counted here for tests/operators
+        self.snapshot_failures = 0
         # restore in progress: (Snapshot, expected chunk hashes, chunks so far)
         self._restoring: Optional[tuple] = None
         self._load()
@@ -222,7 +226,13 @@ class PersistentKVStoreApp(KVStoreApp):
                     self._snapshot_store.save(snap, chunks)
                     self._snapshot_store.prune(self._snapshot_keep_recent)
             except Exception:
-                pass  # a failed snapshot must never wedge the worker
+                # a failed snapshot must never wedge the worker, but it
+                # must not be silent either — before this moved off the
+                # consensus thread, a failure surfaced in commit()
+                self.snapshot_failures += 1
+                logging.getLogger(__name__).exception(
+                    "snapshot production failed at height %d", height
+                )
             finally:
                 self._snap_queue.task_done()
 

@@ -100,8 +100,7 @@ class WindowPlan:
     """A ragged window flattened to lanes.  `coords[j] = (h, v)` maps lane j
     back to its grid cell; `seg_ids[j] = h` feeds the segment tallies.
     Malformed votes (wrong sig/pub length, undecompressable key) keep their
-    lane — they must count as *failures*, not absences — with
-    ``wellformed[j] = False``."""
+    lane — they must count as *failures*, not absences."""
 
     H: int
     V: int  # widest row (the ok-grid width)
@@ -111,7 +110,12 @@ class WindowPlan:
     msgs: list
     sigs: list
     powers: np.ndarray  # (n,) int64
-    wellformed: np.ndarray  # (n,) bool
+    wellformed: np.ndarray  # (n,) bool — ed25519-kernel-shaped (32B pub,
+    # 64B sig).  A DEVICE-path precondition only: the ed25519 prologue can
+    # ingest only shaped lanes, so unshaped ones auto-fail there (all lanes
+    # of a device window are ed25519 by the all_ed25519 gate).  The host
+    # path ignores this flag — secp256k1 (33B pubs), multisig aggregates
+    # and odd sig lengths are legal there and verify_generic decides them.
     totals: np.ndarray  # (H,) int64 per-height total voting power
     dev: Optional[tuple] = None  # padded device tensors (pack_device)
     dev_shape: Optional[Tuple[int, int]] = None  # (lane bucket, seg bucket)
@@ -221,7 +225,12 @@ def pack_device(plan: WindowPlan, mesh=None) -> WindowPlan:
     present = z((B,), bool)
     is_vote = z((B,), bool)
     power = z((B,), np.int64)
-    seg_ids = z((B,), np.int32)
+    # padding lanes point at the LAST segment, not segment 0: real lanes
+    # end at seg ≤ H-1 ≤ S-1, so the array stays monotonically
+    # non-decreasing and segment_sum's indices_are_sorted=True contract
+    # holds (padding carries zero power and is_vote=False, so the S-1
+    # tallies are unaffected)
+    seg_ids = np.full((B,), S - 1, np.int32)
     if n:
         is_vote[:n] = True
         seg_ids[:n] = plan.seg_ids
@@ -398,22 +407,33 @@ def _execute_host(plan: WindowPlan, verifier=None) -> WindowVerdict:
     """Lane verification through the BatchVerifier boundary (verify_generic
     — mixed key types, custom verifiers, the process default backend), with
     the SAME segment tallies in numpy.  int64 throughout: np.bincount would
-    round powers through float64."""
+    round powers through float64.
+
+    EVERY present lane goes through verify_generic — secp256k1 (33-byte
+    pubs, DER sigs), multisig aggregates and odd sig lengths are decided
+    per key type there, not pre-filtered by the ed25519 shape check (that
+    check is a device-kernel precondition, not a validity rule).  The one
+    structural failure decided here: a raw (non-PubKey) key that is not 32
+    bytes cannot be any key type we speak — its lane fails."""
     from tendermint_tpu.crypto.batch import verify_generic
     from tendermint_tpu.crypto.keys import PubKey, PubKeyEd25519
 
     n = plan.n_lanes
     ok_l = np.zeros((n,), dtype=bool)
     if n:
-        idx = np.flatnonzero(plan.wellformed)
-        if idx.size:
-            pub_objs = []
-            for j in idx:
-                pk = plan.pubs[j]
-                if not isinstance(pk, PubKey):
+        idx: List[int] = []
+        pub_objs = []
+        for j in range(n):
+            pk = plan.pubs[j]
+            if not isinstance(pk, PubKey):
+                try:
                     pk = PubKeyEd25519(bytes(pk))
-                pub_objs.append(pk)
-            ok_l[idx] = verify_generic(
+                except (ValueError, TypeError):
+                    continue  # wrong-length raw key: lane stays failed
+            idx.append(j)
+            pub_objs.append(pk)
+        if idx:
+            ok_l[np.asarray(idx)] = verify_generic(
                 pub_objs,
                 [plan.msgs[j] for j in idx],
                 [plan.sigs[j] for j in idx],
@@ -513,12 +533,29 @@ class WindowPipeline:
         self, specs: Iterable[Tuple[Sequence, Sequence, Sequence]]
     ) -> Iterator[WindowVerdict]:
         q: "queue.Queue" = queue.Queue(maxsize=self.prefetch)
+        stop = threading.Event()
         use_device = self.use_device
         mesh = self.mesh
+
+        def _put(item) -> bool:
+            """Bounded put that gives up when the consumer is gone — a
+            syncer that raises on the first bad sub-window verdict abandons
+            this generator mid-stream, and a plain q.put would park the
+            worker forever on the full queue (leaking the thread plus up to
+            `prefetch` packed windows per rejected snapshot)."""
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
 
         def worker():
             try:
                 for votes, powers, totals in specs:
+                    if stop.is_set():
+                        return
                     with trace.span("planner.pack", H=len(votes)):
                         plan = plan_window(votes, powers, totals)
                         dev = use_device if use_device is not None else (
@@ -526,22 +563,34 @@ class WindowPipeline:
                         )
                         if dev and plan.all_ed25519():
                             pack_device(plan, mesh)
-                    q.put(("plan", plan))
+                    if not _put(("plan", plan)):
+                        return
             except BaseException as e:  # re-raised on the consumer side
-                q.put(("err", e))
+                _put(("err", e))
             else:
-                q.put(("done", None))
+                _put(("done", None))
 
         threading.Thread(
             target=worker, name="planner-pack", daemon=True
         ).start()
-        while True:
-            kind, item = q.get()
-            if kind == "done":
-                return
-            if kind == "err":
-                raise item
-            yield execute_plan(
-                item, mesh=mesh, verifier=self.verifier,
-                use_device=use_device,
-            )
+        try:
+            while True:
+                kind, item = q.get()
+                if kind == "done":
+                    return
+                if kind == "err":
+                    raise item
+                yield execute_plan(
+                    item, mesh=mesh, verifier=self.verifier,
+                    use_device=use_device,
+                )
+        finally:
+            # generator closed/abandoned (GeneratorExit, consumer raise,
+            # normal end): release the worker promptly — signal stop, then
+            # drain whatever it already parked in the queue
+            stop.set()
+            try:
+                while True:
+                    q.get_nowait()
+            except queue.Empty:
+                pass

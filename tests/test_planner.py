@@ -167,6 +167,100 @@ class TestPlannerExactness:
         assert verdict.committed.tolist() == [True]
 
 
+class TestPlannerMixedKeys:
+    """Regression: the ed25519 shape check (32B pub / 64B sig) is a DEVICE
+    kernel precondition, not a validity rule — the host path must hand
+    secp256k1 keys, multisig aggregates and odd sig lengths to
+    verify_generic instead of auto-failing them (which stalled fast sync
+    and rejected snapshots on any mixed-key valset)."""
+
+    def _mixed_window(self):
+        from tendermint_tpu.crypto.keys import PrivKeyEd25519, PrivKeySecp256k1
+        from tendermint_tpu.crypto.multisig import (
+            Multisignature,
+            PubKeyMultisigThreshold,
+        )
+
+        ed_privs = [PrivKeyEd25519.generate(bytes([i + 1]) * 32) for i in range(3)]
+        sk_privs = [
+            PrivKeySecp256k1.from_secret(bytes([i + 9]) * 32) for i in range(2)
+        ]
+        ms_privs = [PrivKeyEd25519.generate(bytes([i + 33]) * 32) for i in range(3)]
+        ms_pubs = [p.pub_key() for p in ms_privs]
+        mpk = PubKeyMultisigThreshold(k=2, pubkeys=tuple(ms_pubs))
+
+        def ms_sig(msg, signers=(0, 2)):
+            ms = Multisignature.new(3)
+            for i in signers:
+                ms.add_signature_from_pubkey(
+                    ms_privs[i].sign(msg), ms_pubs[i], ms_pubs
+                )
+            return ms.marshal()
+
+        # h0: ed25519-only; h1: secp256k1-only; h2: one of each + multisig
+        msgs = [b"mixed-%d" % h for h in range(3)]
+        votes = [
+            [(p.pub_key(), msgs[0], p.sign(msgs[0])) for p in ed_privs],
+            [(p.pub_key(), msgs[1], p.sign(msgs[1])) for p in sk_privs],
+            [
+                (ed_privs[0].pub_key(), msgs[2], ed_privs[0].sign(msgs[2])),
+                (sk_privs[0].pub_key(), msgs[2], sk_privs[0].sign(msgs[2])),
+                (mpk, msgs[2], ms_sig(msgs[2])),
+            ],
+        ]
+        powers = [[1] * 3, [1] * 2, [1] * 3]
+        totals = [3, 2, 3]
+        return votes, powers, totals
+
+    def test_valid_mixed_window_commits(self):
+        from tendermint_tpu.parallel import planner
+
+        votes, powers, totals = self._mixed_window()
+        verdict = planner.verify_window(votes, powers, totals)
+        # ok is a dense (H, max V) grid — check the present cells per row
+        for h, row in enumerate(votes):
+            assert verdict.ok[h, : len(row)].all(), (
+                f"every valid mixed-key vote must verify (height {h})"
+            )
+        assert verdict.sigs_ok.tolist() == [True, True, True]
+        assert verdict.committed.tolist() == [True, True, True]
+        assert verdict.tally.tolist() == [3, 2, 3]
+
+    def test_mixed_window_via_device_request_falls_back(self):
+        """use_device=True with non-ed25519 PubKeys must still verify them
+        (the lane kernel can't ride them; the verifier boundary can)."""
+        from tendermint_tpu.parallel import planner
+
+        votes, powers, totals = self._mixed_window()
+        verdict = planner.verify_window(votes, powers, totals, use_device=True)
+        for h, row in enumerate(votes):
+            assert verdict.ok[h, : len(row)].all()
+        assert verdict.committed.tolist() == [True, True, True]
+
+    def test_forged_secp_vote_fails_its_commit_only(self):
+        from tendermint_tpu.parallel import planner
+
+        votes, powers, totals = self._mixed_window()
+        pub, msg, sig = votes[1][1]
+        bad = bytearray(sig)
+        bad[-1] ^= 1
+        votes[1][1] = (pub, msg, bytes(bad))
+        verdict = planner.verify_window(votes, powers, totals)
+        assert verdict.sigs_ok.tolist() == [True, False, True]
+        assert not verdict.ok[1, 1]
+
+    def test_wrong_length_raw_key_fails_lane_without_raising(self):
+        from tendermint_tpu.parallel import planner
+
+        votes, powers, totals = _ragged_window([3], tag=70)
+        pub, msg, sig = votes[0][1]
+        votes[0][1] = (bytes(pub)[:31], msg, sig)  # 31-byte raw key
+        verdict = planner.verify_window(votes, powers, totals)
+        assert not verdict.ok[0, 1]
+        assert verdict.ok[0, 0] and verdict.ok[0, 2]
+        assert not bool(verdict.sigs_ok[0])
+
+
 class TestPlannerBuckets:
     def test_one_compile_per_bucket(self):
         """Windows of differing (H, V) that land in the same (lane, seg)
@@ -225,6 +319,30 @@ class TestWindowPipeline:
         assert len(verdicts) == len(specs)
         for verdict, (votes, powers, totals) in zip(verdicts, specs):
             _assert_verdict_matches(verdict, votes, powers, totals)
+
+    def test_abandoned_pipeline_releases_worker_thread(self):
+        """Regression: a consumer that raises on the first verdict (the
+        syncer rejecting a snapshot) abandons the generator with the
+        bounded queue full; the worker must exit instead of parking on
+        q.put forever and leaking a thread per rejected snapshot."""
+        import threading
+
+        from tendermint_tpu.parallel import planner
+
+        specs = [_ragged_window([2], tag=45 + i) for i in range(8)]
+        pipe = planner.WindowPipeline(use_device=False, prefetch=1)
+        it = pipe.run(iter(specs))
+        next(it)  # consume one verdict, then walk away
+        it.close()
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            workers = [
+                t for t in threading.enumerate() if t.name == "planner-pack"
+            ]
+            if not workers:
+                break
+            time.sleep(0.02)
+        assert not workers, "planner-pack worker leaked after abandonment"
 
     def test_pipeline_propagates_spec_errors_in_order(self):
         from tendermint_tpu.parallel import planner
@@ -322,3 +440,46 @@ class TestAsyncSnapshotProduction:
         assert commit_dt < 0.2, f"commit() paid for chunking ({commit_dt:.3f}s)"
         app.wait_snapshots()
         assert [s.height for s in store.list()] == [1]
+
+    def test_snapshot_failure_is_logged_and_counted(self, monkeypatch, caplog):
+        """A failing snapshot must not wedge the worker — but it must be
+        loud: logged with traceback and counted on the app (regression for
+        the silent bare-except swallow)."""
+        import logging
+
+        from tendermint_tpu.abci import types as abci
+        from tendermint_tpu.abci.examples.kvstore import PersistentKVStoreApp
+        from tendermint_tpu.libs.db.kv import MemDB
+        from tendermint_tpu.statesync import chunker
+        from tendermint_tpu.statesync.store import SnapshotStore
+
+        real = chunker.make_snapshot
+        calls = []
+
+        def flaky_make_snapshot(height, blob, chunk_size):
+            calls.append(height)
+            if height == 1:
+                raise OSError("disk full")
+            return real(height, blob, chunk_size)
+
+        monkeypatch.setattr(chunker, "make_snapshot", flaky_make_snapshot)
+        app = PersistentKVStoreApp()
+        store = SnapshotStore(MemDB())
+        app.configure_snapshots(store, interval=1, chunk_size=32)
+        with caplog.at_level(
+            logging.ERROR, logger="tendermint_tpu.abci.examples.kvstore"
+        ):
+            for tx in (b"a=b", b"c=d"):
+                app.begin_block(abci.RequestBeginBlock())
+                assert app.deliver_tx(abci.RequestDeliverTx(tx=tx)).code == 0
+                app.end_block(abci.RequestEndBlock())
+                app.commit(abci.RequestCommit())
+            app.wait_snapshots()
+        assert calls == [1, 2]
+        assert app.snapshot_failures == 1
+        # the worker survived the failure and produced the next snapshot
+        assert [s.height for s in store.list()] == [2]
+        assert any(
+            "snapshot production failed at height 1" in r.message
+            for r in caplog.records
+        )
