@@ -3,7 +3,7 @@
 PYTHON ?= python
 
 .PHONY: test test_slow test_sanitizers bench bench-local bench_fastsync \
-        planner-bench bench_secp bench_multisig metrics-lint \
+        planner-bench bench_secp bench_multisig metrics-lint bench-check \
         statesync-smoke localnet-start localnet-stop build-docker-localnode
 
 test:
@@ -41,6 +41,11 @@ bench_multisig:
 # to lint scrape snapshots: make metrics-lint ARGS="/tmp/m.prom"
 metrics-lint:
 	JAX_PLATFORMS=cpu $(PYTHON) scripts/metrics_lint.py $(ARGS)
+
+# fail on >20% fastsync_blocks_per_s regression between the two newest
+# BENCH_r*.json rounds that parsed
+bench-check:
+	$(PYTHON) scripts/bench_check.py $(ARGS)
 
 # in-process snapshot restore (producer -> chunk fetch -> light-client verify
 # -> batched backfill) + linted tendermint_statesync_* scrape

@@ -1,0 +1,96 @@
+"""Regression gate over the committed BENCH_r*.json ledger.
+
+Compares the newest round's `parsed.fastsync_blocks_per_s` against the most
+recent previous round that has one (rounds that timed out carry
+``parsed: null`` and are skipped) and exits 1 on a >20% drop.  Run it after
+a bench round, or via ``make bench-check``.
+
+Usage: python scripts/bench_check.py [--threshold 0.20] [--dir REPO_ROOT]
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+METRIC = "fastsync_blocks_per_s"
+DEFAULT_THRESHOLD = 0.20
+
+
+def load_rounds(root: str):
+    """[(round_number, path, blocks_per_s or None)] sorted oldest→newest."""
+    rounds = []
+    for path in glob.glob(os.path.join(root, "BENCH_r*.json")):
+        m = re.search(r"BENCH_r(\d+)\.json$", os.path.basename(path))
+        if not m:
+            continue
+        try:
+            with open(path) as f:
+                data = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"bench-check: unreadable {path}: {e}", file=sys.stderr)
+            continue
+        parsed = data.get("parsed")
+        value = None
+        if isinstance(parsed, dict):
+            v = parsed.get(METRIC)
+            if isinstance(v, (int, float)):
+                value = float(v)
+        rounds.append((int(m.group(1)), path, value))
+    rounds.sort()
+    return rounds
+
+
+def check(root: str, threshold: float) -> int:
+    rounds = load_rounds(root)
+    if not rounds:
+        print("bench-check: no BENCH_r*.json files — nothing to compare")
+        return 0
+    newest_n, newest_path, newest = rounds[-1]
+    if newest is None:
+        print(
+            f"bench-check: newest round r{newest_n:02d} has no {METRIC} "
+            f"(timed out / unparsed) — skipping"
+        )
+        return 0
+    prev = [(n, p, v) for n, p, v in rounds[:-1] if v is not None]
+    if not prev:
+        print(
+            f"bench-check: r{newest_n:02d} {METRIC}={newest:g} — "
+            f"no earlier round to compare against"
+        )
+        return 0
+    prev_n, prev_path, prev_v = prev[-1]
+    if prev_v <= 0:
+        print(f"bench-check: previous value {prev_v:g} not positive — skipping")
+        return 0
+    ratio = newest / prev_v
+    drop = 1.0 - ratio
+    line = (
+        f"bench-check: {METRIC} r{prev_n:02d}={prev_v:g} → "
+        f"r{newest_n:02d}={newest:g} ({ratio:.2%} of previous)"
+    )
+    if drop > threshold:
+        print(f"{line} — REGRESSION beyond {threshold:.0%}", file=sys.stderr)
+        return 1
+    print(f"{line} — ok (threshold {threshold:.0%})")
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--threshold", type=float, default=DEFAULT_THRESHOLD,
+                   help="max allowed fractional drop (default 0.20)")
+    p.add_argument("--dir", default=os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))
+    ), help="directory holding BENCH_r*.json")
+    args = p.parse_args(argv)
+    return check(args.dir, args.threshold)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -300,12 +300,55 @@ def _self_check():
     vm.record_planner(680, 1024)
 
     nm = NodeMetrics()
+    # exercise the hot-path families so the lint covers sample lines, not
+    # just TYPE/HELP headers
+    nm.record_peer_traffic("f3a1", 0x40, sent=2048, received=4096)
+    nm.record_peer_traffic("f3a1", 0x20, sent=17)
+    nm.set_peer_pending("f3a1", 1024)
+    nm.messages_sent.add(3.0, ("0x40",))
+    nm.messages_received.add(2.0, ("0x40",))
+    nm.step_duration.observe(0.004, ("NEW_ROUND",))
+    nm.step_duration.observe(0.12, ("PREVOTE",))
+    nm.vote_arrival_latency.observe(0.03, ("prevote",))
+    nm.wal_append_seconds.observe(0.0004)
+    nm.wal_fsync_seconds.observe(0.002)
+    nm.mempool_tx_size_bytes.observe(512.0)
+    nm.mempool_failed_txs.add(1.0)
+    nm.mempool_recheck_times.add(2.0)
+    nm.forget_peer("f3a1")  # removal must leave the exposition lintable
 
     failures = []
+    node_text = nm.registry.expose_text()
+    # reference-name parity: the families the reference exports under these
+    # exact names (consensus/metrics.go, p2p/metrics.go, mempool/metrics.go)
+    # must appear in the node exposition — renames break dashboards
+    reference_names = (
+        "tendermint_consensus_height",
+        "tendermint_consensus_rounds",
+        "tendermint_consensus_step_duration_seconds",
+        "tendermint_p2p_peers",
+        "tendermint_p2p_peer_receive_bytes_total",
+        "tendermint_p2p_peer_send_bytes_total",
+        "tendermint_p2p_peer_pending_send_bytes",
+        "tendermint_mempool_size",
+        "tendermint_mempool_tx_size_bytes",
+        "tendermint_mempool_failed_txs",
+        "tendermint_mempool_recheck_times",
+        "tendermint_consensus_wal_append_seconds",
+        "tendermint_consensus_wal_fsync_seconds",
+        "tendermint_state_block_processing_time",
+    )
+    missing = [
+        n for n in reference_names if f"# TYPE {n} " not in node_text
+    ]
+    if missing:
+        failures.append(
+            ("reference-name parity", [f"missing family {n}" for n in missing])
+        )
     for label, text in (
         ("escaping registry", r.expose_text()),
         ("VerifyMetrics", vm.registry.expose_text()),
-        ("NodeMetrics(+verify attached)", nm.registry.expose_text()),
+        ("NodeMetrics(+verify attached)", node_text),
     ):
         errs = lint_text(text)
         if errs:

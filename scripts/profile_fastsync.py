@@ -7,6 +7,12 @@ cumulative-time functions plus a blocks/s ceiling.  This is the measurement
 behind the host-path optimisation work (the device verify rides on top; the
 host ceiling bounds end-to-end blocks/s).
 
+The verify path is the lane-packed `parallel/planner` (the padded-grid path
+is gone), so the report carries two planner-aware slices: the
+cumulative-time rows restricted to planner frames, and the dispatch cost
+ledger (libs/profile.py) totals — pack vs. run seconds, lanes, occupancy —
+for the profiled run.
+
 Usage: python scripts/profile_fastsync.py [n_blocks] [n_vals] [window]
 """
 
@@ -67,17 +73,44 @@ def main():
             pos += n_ok
         return applied / (time.perf_counter() - t0)
 
+    from tendermint_tpu.libs.profile import get_profiler
+
     rate = run_pipeline()  # warm
     print(f"# warm rate: {rate:.0f} blocks/s ({1e3 / rate:.3f} ms/block)")
 
+    get_profiler().reset()  # ledger the profiled run only
     prof = cProfile.Profile()
     prof.enable()
     rate = run_pipeline()
     prof.disable()
     print(f"# profiled rate: {rate:.0f} blocks/s ({1e3 / rate:.3f} ms/block)")
     s = io.StringIO()
-    pstats.Stats(prof, stream=s).sort_stats("cumulative").print_stats(45)
+    st = pstats.Stats(prof, stream=s)
+    st.sort_stats("cumulative").print_stats(45)
     print(s.getvalue())
+
+    # planner slice: same stats restricted to the lane-packed verify path
+    s = io.StringIO()
+    pstats.Stats(prof, stream=s).sort_stats("cumulative").print_stats(
+        r"parallel[/\\]planner"
+    )
+    print("# --- planner slices (lane-packed path) ---")
+    print(s.getvalue())
+
+    entries = get_profiler().entries()
+    if entries:
+        pack = sum(e["pack_seconds"] for e in entries)
+        run = sum(e["run_seconds"] for e in entries)
+        compiles = sum(1 for e in entries if e["compiled"])
+        lanes = sum(e["lanes_present"] for e in entries)
+        disp = sum(e["lanes_dispatched"] for e in entries)
+        nbytes = sum(e["bytes_to_device"] for e in entries)
+        print("# --- dispatch cost ledger (profiled run) ---")
+        print(f"# dispatches={len(entries)} compiles={compiles} "
+              f"pack={pack:.3f}s run={run:.3f}s "
+              f"lanes={lanes} dispatched={disp} "
+              f"occupancy={lanes / disp if disp else 1.0:.2f} "
+              f"bytes_to_device={nbytes}")
 
 
 if __name__ == "__main__":

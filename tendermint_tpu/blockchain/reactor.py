@@ -157,10 +157,13 @@ def verify_block_window(
     # the planner's WindowVerdict (mixed-key valsets fall back to the
     # verifier path inside execute_plan, keeping the caller's verifier)
     total = valset.total_voting_power()
-    verdict = planner.verify_window(
-        votes_rows, power_rows, [total] * usable,
-        mesh=mesh, verifier=verifier, use_device=mesh is not None,
-    )
+    from tendermint_tpu.libs.profile import get_profiler
+
+    with get_profiler().window(blocks[0].height, heights=usable):
+        verdict = planner.verify_window(
+            votes_rows, power_rows, [total] * usable,
+            mesh=mesh, verifier=verifier, use_device=mesh is not None,
+        )
 
     # 3. translate the per-height verdict; stop at the first invalid commit
     for i in range(usable):

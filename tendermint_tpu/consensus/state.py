@@ -92,6 +92,7 @@ class ConsensusState(BaseService):
         evpool,
         wal: Optional[object] = None,
         logger=None,
+        metrics=None,  # NodeMetrics or None
     ):
         super().__init__("consensus.State", logger)
         self.config = config
@@ -99,6 +100,11 @@ class ConsensusState(BaseService):
         self.block_store = block_store
         self.mempool = mempool
         self.evpool = evpool
+        self.metrics = metrics
+        # step-duration accounting: each _new_step observes the wall time
+        # spent in the step being LEFT (None until the first transition)
+        self._step_started: Optional[float] = None
+        self._step_leaving: Optional[str] = None
 
         self.priv_validator = None
 
@@ -257,6 +263,13 @@ class ConsensusState(BaseService):
             )
 
     def _new_step(self) -> None:
+        now = time.monotonic()
+        if self.metrics is not None and self._step_started is not None:
+            dt = now - self._step_started
+            if dt >= 0 and self._step_leaving is not None:
+                self.metrics.step_duration.observe(dt, (self._step_leaving,))
+        self._step_started = now
+        self._step_leaving = self.rs.step.name
         trace.instant(
             "consensus.step",
             height=self.rs.height, round=self.rs.round, step=self.rs.step.name,
@@ -439,6 +452,10 @@ class ConsensusState(BaseService):
             validators.increment_accum(round - rs.round)
 
         self._update_round_step(round, RoundStepType.NEW_ROUND)
+        if self.metrics is not None:
+            # reference sets Rounds here (state.go enterNewRound), not at
+            # commit — round skips show up as they happen
+            self.metrics.rounds.set(round)
         rs.validators = validators
         if round != 0:
             rs.proposal = None
@@ -884,6 +901,22 @@ class ConsensusState(BaseService):
                     self.logger.error("failed to add evidence: %s", ee)
             return False
 
+    def _observe_vote_latency(self, vote: Vote) -> None:
+        """Wall delay between the vote's signed timestamp and its arrival
+        here.  Clock skew can make this negative and a bogus timestamp can
+        make it huge — clamp to [0, 1h) so one bad vote can't wreck the
+        histogram."""
+        if self.metrics is None:
+            return
+        lat = (time.time_ns() - vote.timestamp_ns) / 1e9
+        if 0.0 <= lat < 3600.0:
+            kind = (
+                "prevote"
+                if vote.vote_type == SignedMsgType.PREVOTE
+                else "precommit"
+            )
+            self.metrics.vote_arrival_latency.observe(lat, (kind,))
+
     def _add_vote(self, vote: Vote, peer_id: str) -> bool:
         rs = self.rs
 
@@ -899,6 +932,7 @@ class ConsensusState(BaseService):
             added = rs.last_commit.add_vote(vote)
             if not added:
                 return False
+            self._observe_vote_latency(vote)
             self._publish_vote_event(vote)
             if self.config.skip_timeout_commit and rs.last_commit.has_all():
                 self.enter_new_round(rs.height, 0)
@@ -911,6 +945,7 @@ class ConsensusState(BaseService):
         added = rs.votes.add_vote(vote, peer_id)
         if not added:
             return False
+        self._observe_vote_latency(vote)
         self._publish_vote_event(vote)
 
         if vote.vote_type == SignedMsgType.PREVOTE:

@@ -74,9 +74,10 @@ class TimedWALMessage:
 
 
 class WAL(BaseService):
-    def __init__(self, wal_file: str):
+    def __init__(self, wal_file: str, metrics=None):
         super().__init__("consensus.WAL")
         self.group = Group(wal_file)
+        self.metrics = metrics  # NodeMetrics or None
 
     # writes ---------------------------------------------------------------
     def write(self, msg: object) -> None:
@@ -87,16 +88,22 @@ class WAL(BaseService):
         if len(payload) > MAX_MSG_SIZE_BYTES:
             raise ValueError(f"WAL msg too big: {len(payload)}")
         rec = struct.pack("<I", zlib.crc32(payload)) + encode_uvarint(len(payload)) + payload
+        t0 = time.monotonic()
         with trace.span("wal.append", bytes=len(rec)):
             self.group.write(rec)
             self.group.flush()
+        if self.metrics is not None:
+            self.metrics.wal_append_seconds.observe(time.monotonic() - t0)
 
     def write_sync(self, msg: object) -> None:
         """Append + fsync (internal msgs and #ENDHEIGHT use this)."""
         self.write(msg)
         if self.is_running:
+            t0 = time.monotonic()
             with trace.span("wal.fsync"):
                 self.group.sync()
+            if self.metrics is not None:
+                self.metrics.wal_fsync_seconds.observe(time.monotonic() - t0)
 
     def on_start(self) -> None:
         self.group.maybe_rotate()

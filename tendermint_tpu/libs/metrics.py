@@ -78,6 +78,18 @@ class Counter(_Metric):
         with self._mtx:
             self._values[_labels] = self._values.get(_labels, 0.0) + v
 
+    def remove_matching(self, label_name: str, value: str) -> int:
+        """Drop every series whose `label_name` equals `value` — the
+        cardinality-hygiene hook for per-peer labels on disconnect."""
+        if label_name not in self.label_names:
+            return 0
+        i = self.label_names.index(label_name)
+        with self._mtx:
+            doomed = [lv for lv in self._values if lv[i] == value]
+            for lv in doomed:
+                del self._values[lv]
+        return len(doomed)
+
     def expose(self) -> List[str]:
         with self._mtx:
             items = sorted(self._values.items())
@@ -114,6 +126,18 @@ class Gauge(_Metric):
     def add(self, v: float = 1.0, _labels: Tuple[str, ...] = ()) -> None:
         with self._mtx:
             self._values[_labels] = self._values.get(_labels, 0.0) + v
+
+    def remove_matching(self, label_name: str, value: str) -> int:
+        """Drop every series whose `label_name` equals `value` (see
+        Counter.remove_matching)."""
+        if label_name not in self.label_names:
+            return 0
+        i = self.label_names.index(label_name)
+        with self._mtx:
+            doomed = [lv for lv in self._values if lv[i] == value]
+            for lv in doomed:
+                del self._values[lv]
+        return len(doomed)
 
     def expose(self) -> List[str]:
         with self._mtx:
@@ -469,10 +493,67 @@ class NodeMetrics:
         )
         self.total_txs = r.gauge("consensus_total_txs", "Total txs committed")
         self.fast_syncing = r.gauge("consensus_fast_syncing", "1 while fast syncing")
+        self.step_duration = r.histogram(
+            "consensus_step_duration_seconds",
+            "Wall seconds spent in each consensus step (labeled by the step "
+            "being left)",
+            label_names=("step",),
+        )
+        self.vote_arrival_latency = r.histogram(
+            "consensus_vote_arrival_latency_seconds",
+            "Wall-clock delay between a vote's signed timestamp and its "
+            "arrival at the state machine",
+            label_names=("type",),
+        )
+        self.wal_append_seconds = r.histogram(
+            "consensus_wal_append_seconds", "WAL buffered-append wall seconds",
+            buckets=[b / 10 for b in _DEFAULT_BUCKETS],
+        )
+        self.wal_fsync_seconds = r.histogram(
+            "consensus_wal_fsync_seconds", "WAL fsync wall seconds",
+            buckets=[b / 10 for b in _DEFAULT_BUCKETS],
+        )
         # p2p
         self.peers = r.gauge("p2p_peers", "Connected peers")
+        self.peer_receive_bytes = r.counter(
+            "p2p_peer_receive_bytes_total",
+            "Wire bytes received from a peer by channel (packet framing "
+            "included; sourced from the same stream the flowrate recv "
+            "monitor measures)",
+            label_names=("peer_id", "chID"),
+        )
+        self.peer_send_bytes = r.counter(
+            "p2p_peer_send_bytes_total",
+            "Wire bytes sent to a peer by channel (packet framing included)",
+            label_names=("peer_id", "chID"),
+        )
+        self.peer_pending_send_bytes = r.gauge(
+            "p2p_peer_pending_send_bytes",
+            "Bytes queued (not yet on the wire) toward a peer",
+            label_names=("peer_id",),
+        )
+        self.messages_received = r.counter(
+            "p2p_messages_received_total",
+            "Complete messages delivered to reactors by channel",
+            label_names=("chID",),
+        )
+        self.messages_sent = r.counter(
+            "p2p_messages_sent_total",
+            "Messages queued toward peers by channel",
+            label_names=("chID",),
+        )
         # mempool
         self.mempool_size = r.gauge("mempool_size", "Unconfirmed txs in the mempool")
+        self.mempool_tx_size_bytes = r.histogram(
+            "mempool_tx_size_bytes", "Size of accepted mempool txs",
+            buckets=_SIZE_BUCKETS,
+        )
+        self.mempool_failed_txs = r.counter(
+            "mempool_failed_txs", "Txs rejected by CheckTx"
+        )
+        self.mempool_recheck_times = r.counter(
+            "mempool_recheck_times", "Txs re-checked after a commit"
+        )
         # state
         self.block_processing_time = r.histogram(
             "state_block_processing_time", "ApplyBlock seconds",
@@ -485,6 +566,10 @@ class NodeMetrics:
         self.statesync = get_statesync_metrics()
         r.attach(self.statesync.registry)
         self._last_block_time: Optional[float] = None
+        # cardinality hygiene: at most MAX_PEER_LABELS distinct peer ids ever
+        # get their own label value; the rest collapse into "overflow"
+        self._peer_label_ids: set = set()
+        self._peer_label_mtx = threading.Lock()
 
     # called from the consensus event path -------------------------------------
     def record_block(self, block, valset) -> None:
@@ -520,3 +605,37 @@ class NodeMetrics:
         synced blocks arrived at replay speed, so the next live block's
         interval measured against them would be garbage."""
         self._last_block_time = None
+
+    # per-peer traffic ----------------------------------------------------------
+    MAX_PEER_LABELS = 64
+
+    def _peer_label(self, peer_id: str) -> str:
+        with self._peer_label_mtx:
+            if peer_id in self._peer_label_ids:
+                return peer_id
+            if len(self._peer_label_ids) < self.MAX_PEER_LABELS:
+                self._peer_label_ids.add(peer_id)
+                return peer_id
+        return "overflow"
+
+    def record_peer_traffic(self, peer_id: str, chan_id: int,
+                            sent: int = 0, received: int = 0) -> None:
+        pid = self._peer_label(peer_id)
+        ch = f"{chan_id:#x}"
+        if sent:
+            self.peer_send_bytes.add(sent, (pid, ch))
+        if received:
+            self.peer_receive_bytes.add(received, (pid, ch))
+
+    def set_peer_pending(self, peer_id: str, pending: int) -> None:
+        self.peer_pending_send_bytes.set(float(pending),
+                                         (self._peer_label(peer_id),))
+
+    def forget_peer(self, peer_id: str) -> None:
+        """Drop every per-peer series for a disconnected peer so label
+        cardinality tracks the live peer set, not its history."""
+        with self._peer_label_mtx:
+            self._peer_label_ids.discard(peer_id)
+        self.peer_send_bytes.remove_matching("peer_id", peer_id)
+        self.peer_receive_bytes.remove_matching("peer_id", peer_id)
+        self.peer_pending_send_bytes.remove_matching("peer_id", peer_id)

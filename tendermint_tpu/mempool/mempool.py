@@ -193,13 +193,19 @@ class Mempool(MempoolIface):
             memtx = MempoolTx(height=self._height, gas_wanted=res.gas_wanted, tx=tx)
             el = self._txs.push_back(memtx)
             self._tx_map[tmhash(tx)] = el
+            if self.metrics is not None:
+                self.metrics.mempool_tx_size_bytes.observe(len(tx))
             self.logger.debug("added good tx size=%d", self.size())
             self._notify_txs_available()
         else:
             self.logger.debug("rejected bad tx code=%d log=%s", res.code, res.log)
+            if self.metrics is not None:
+                self.metrics.mempool_failed_txs.add(1)
             self.cache.remove(tx)
 
     def _res_cb_recheck(self, req: abci.RequestCheckTx, res: abci.ResponseCheckTx) -> None:
+        if self.metrics is not None:
+            self.metrics.mempool_recheck_times.add(1)
         cursor = self._recheck_cursor
         memtx = cursor.value
         if memtx.tx != req.tx:

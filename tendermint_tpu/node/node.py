@@ -194,7 +194,7 @@ class Node(BaseService):
             if root and config.consensus.wal_path
             else None
         )
-        wal = WAL(wal_file) if wal_file else None
+        wal = WAL(wal_file, metrics=self.metrics) if wal_file else None
         self.consensus_state = ConsensusState(
             config.consensus,
             state.copy(),
@@ -203,6 +203,7 @@ class Node(BaseService):
             self.mempool,
             self.evidence_pool,
             wal=wal,
+            metrics=self.metrics,
         )
         self.consensus_state.set_event_bus(self.event_bus)
         if priv_validator is not None:
@@ -404,6 +405,7 @@ class Node(BaseService):
             ),
             mconfig,
             peer_filters=peer_filters,
+            metrics=self.metrics,
         )
         self.switch.add_reactor("consensus", self.consensus_reactor)
         self.switch.add_reactor("blockchain", self.blockchain_reactor)
@@ -449,8 +451,10 @@ class Node(BaseService):
                         continue
                     try:
                         rs = self.consensus_state.get_round_state()
+                        # rounds gauge is set at enter_new_round (the
+                        # reference site) — not here, where it would read
+                        # the NEXT height's round
                         self.metrics.record_block(msg.data.block, rs.validators)
-                        self.metrics.rounds.set(rs.round)
                     except Exception:
                         pass
 
@@ -501,6 +505,10 @@ class Node(BaseService):
         while not self._quit.is_set():
             try:
                 self.metrics.peers.set(self.switch.peers.size())
+                for peer in self.switch.peers.list():
+                    self.metrics.set_peer_pending(
+                        peer.id, peer.pending_send_bytes()
+                    )
                 if self.blockchain_reactor is not None:
                     self.metrics.fast_syncing.set(
                         1 if self.blockchain_reactor.fast_sync else 0

@@ -93,6 +93,10 @@ class _Channel:
         self.sent_pos = 0
         self.recently_sent = 0  # exponentially decayed byte count
         self.max_payload = max_payload
+        # payload bytes queued but not yet packetized onto the wire;
+        # incremented on producer threads, drained on the send thread
+        self.pending_bytes = 0
+        self._pending_mtx = threading.Lock()
         # recv-side reassembly
         self.recving = bytearray()
 
@@ -112,7 +116,13 @@ class _Channel:
             self.sending = b""
             self.sent_pos = 0
         self.recently_sent += len(chunk)
+        with self._pending_mtx:
+            self.pending_bytes = max(0, self.pending_bytes - len(chunk))
         return chunk, eof
+
+    def add_pending(self, n: int) -> None:
+        with self._pending_mtx:
+            self.pending_bytes += n
 
     # -- recv side -----------------------------------------------------------
     def recv_packet(self, chunk: bytes, eof: bool) -> Optional[bytes]:
@@ -141,6 +151,7 @@ class MConnection(BaseService):
         on_error: Callable[[Exception], None],
         config: Optional[MConnConfig] = None,
         name: str = "MConn",
+        on_traffic: Optional[Callable[[int, int, int], None]] = None,
     ):
         super().__init__(name=name)
         self._conn = conn
@@ -151,6 +162,10 @@ class MConnection(BaseService):
         }
         self._on_receive = on_receive
         self._on_error = on_error
+        # on_traffic(chan_id, sent_bytes, received_bytes): per-channel wire
+        # accounting at packet granularity (type byte + header + chunk), the
+        # same bytes the flowrate monitors count for msg packets
+        self._on_traffic = on_traffic
         self._send_monitor = Monitor()
         self._recv_monitor = Monitor()
         self._send_signal = threading.Event()  # "there may be work"
@@ -201,6 +216,7 @@ class MConnection(BaseService):
             ch.send_queue.put(msg, timeout=self.config.send_timeout)
         except queue.Full:
             return False
+        ch.add_pending(len(msg))
         self._send_signal.set()
         return True
 
@@ -215,12 +231,17 @@ class MConnection(BaseService):
             ch.send_queue.put_nowait(msg)
         except queue.Full:
             return False
+        ch.add_pending(len(msg))
         self._send_signal.set()
         return True
 
     def can_send(self, chan_id: int) -> bool:
         ch = self._channels.get(chan_id)
         return ch is not None and not ch.send_queue.full()
+
+    def pending_send_bytes(self) -> int:
+        """Payload bytes queued across all channels but not yet on the wire."""
+        return sum(ch.pending_bytes for ch in self._channels.values())
 
     def status(self) -> dict:
         return {
@@ -231,6 +252,7 @@ class MConnection(BaseService):
                     "send_queue": ch.send_queue.qsize(),
                     "recently_sent": ch.recently_sent,
                     "priority": ch.desc.priority,
+                    "pending_bytes": ch.pending_bytes,
                 }
                 for cid, ch in self._channels.items()
             },
@@ -289,6 +311,7 @@ class MConnection(BaseService):
 
                 # batch up to NUM_BATCH_PACKET_MSGS packets per wakeup,
                 # channel choice weighted by least recently_sent/priority
+                sent_by_chan: Dict[int, int] = {}
                 for _ in range(NUM_BATCH_PACKET_MSGS):
                     ch = self._select_channel()
                     if ch is None:
@@ -302,12 +325,20 @@ class MConnection(BaseService):
                     buf.append(0x01 if eof else 0x00)
                     buf.extend(struct.pack("<H", len(chunk)))
                     buf.extend(chunk)
+                    # 5 = type + chan + eof + 2-byte length, matching what
+                    # the recv side attributes for the same packet
+                    sent_by_chan[ch.desc.id] = (
+                        sent_by_chan.get(ch.desc.id, 0) + 5 + len(chunk)
+                    )
 
                 if buf:
                     self._send_monitor.limit(len(buf), cfg.send_rate)
                     self._conn.write(bytes(buf))
                     self._send_monitor.update(len(buf))
                     buf.clear()
+                    if self._on_traffic is not None:
+                        for cid, n in sent_by_chan.items():
+                            self._on_traffic(cid, n, 0)
                 # more queued? loop immediately
                 if any(c.is_send_pending() for c in self._channels.values()):
                     self._send_signal.set()
@@ -348,6 +379,10 @@ class MConnection(BaseService):
                         raise ConnectionError(f"oversized packet ({length})")
                     chunk = self._conn.read_exactly(length) if length else b""
                     self._recv_monitor.update(4 + length)
+                    if self._on_traffic is not None:
+                        # include the type byte counted above so per-channel
+                        # sums reconcile with the recv monitor total
+                        self._on_traffic(chan_id, 0, 5 + length)
                     ch = self._channels.get(chan_id)
                     if ch is None:
                         raise ConnectionError(f"unknown channel {chan_id:#x}")

@@ -32,13 +32,21 @@ class Peer(BaseService):
         outbound: bool = False,
         persistent: bool = False,
         socket_addr: Optional[NetAddress] = None,
+        metrics=None,
     ):
         super().__init__(name=f"Peer-{node_info.id[:8]}")
         self.node_info = node_info
         self.outbound = outbound
         self.persistent = persistent
         self.socket_addr = socket_addr  # actual dialed/accepted address
+        self.metrics = metrics  # NodeMetrics or None
         self._channels = set(node_info.channels)
+        on_traffic = None
+        if metrics is not None:
+            pid = node_info.id
+            on_traffic = lambda cid, s, r: metrics.record_peer_traffic(
+                pid, cid, sent=s, received=r
+            )
         self.mconn = MConnection(
             conn,
             channel_descs,
@@ -46,6 +54,7 @@ class Peer(BaseService):
             on_error=lambda err: on_error(self, err),
             config=mconfig,
             name=f"MConn-{node_info.id[:8]}",
+            on_traffic=on_traffic,
         )
 
     # -- identity --------------------------------------------------------------
@@ -78,12 +87,21 @@ class Peer(BaseService):
     def send(self, chan_id: int, msg: bytes) -> bool:
         if not self.is_running or chan_id not in self._channels:
             return False
-        return self.mconn.send(chan_id, msg)
+        ok = self.mconn.send(chan_id, msg)
+        if ok and self.metrics is not None:
+            self.metrics.messages_sent.add(1, (f"{chan_id:#x}",))
+        return ok
 
     def try_send(self, chan_id: int, msg: bytes) -> bool:
         if not self.is_running or chan_id not in self._channels:
             return False
-        return self.mconn.try_send(chan_id, msg)
+        ok = self.mconn.try_send(chan_id, msg)
+        if ok and self.metrics is not None:
+            self.metrics.messages_sent.add(1, (f"{chan_id:#x}",))
+        return ok
+
+    def pending_send_bytes(self) -> int:
+        return self.mconn.pending_send_bytes()
 
     def has_channel(self, chan_id: int) -> bool:
         return chan_id in self._channels

@@ -65,11 +65,13 @@ class Switch(BaseService):
         config: Optional[SwitchConfig] = None,
         mconfig: Optional[MConnConfig] = None,
         peer_filters=None,  # callables (node_id) -> rejection reason or None
+        metrics=None,  # NodeMetrics or None
     ):
         super().__init__(name="Switch")
         self.transport = transport
         self.config = config or SwitchConfig()
         self.mconfig = mconfig or MConnConfig()
+        self.metrics = metrics
         # post-handshake admission filters by authenticated node ID
         # (node.go:401-419 peerFilters — e.g. the ABCI /p2p/filter/id query)
         self.peer_filters = list(peer_filters or [])
@@ -273,6 +275,7 @@ class Switch(BaseService):
             outbound=up.outbound,
             persistent=persistent,
             socket_addr=up.socket_addr,
+            metrics=self.metrics,
         )
         # register BEFORE starting: an immediate transport error must find the
         # peer in the set so stop_peer_for_error can clean it up (otherwise a
@@ -303,6 +306,8 @@ class Switch(BaseService):
         if reactor is None:
             self.stop_peer_for_error(peer, f"message on unclaimed channel {chan_id:#x}")
             return
+        if self.metrics is not None:
+            self.metrics.messages_received.add(1, (f"{chan_id:#x}",))
         try:
             reactor.receive(chan_id, peer, msg_bytes)
         except Exception as e:
@@ -341,6 +346,9 @@ class Switch(BaseService):
                 pass
         if not removed:
             return
+        if self.metrics is not None:
+            # drop the per-peer label series so cardinality tracks live peers
+            self.metrics.forget_peer(peer.id)
         for reactor in self.reactors.values():
             try:
                 reactor.remove_peer(peer, reason)

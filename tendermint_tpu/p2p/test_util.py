@@ -40,13 +40,15 @@ def make_switch(
     network: str = "test-chain",
     init_switch: Optional[Callable[[int, Switch], Switch]] = None,
     mconfig: Optional[MConnConfig] = None,
+    metrics=None,
 ) -> Switch:
     """A Switch with a fresh node key and test-speed MConn timings.
     `init_switch(i, sw)` registers reactors (test_util.go MakeSwitch)."""
     node_key = NodeKey(PrivKeyEd25519.generate())
     ni = make_node_info(node_key, network)
     transport = MultiplexTransport(ni, node_key)
-    sw = Switch(transport, SwitchConfig(), mconfig or MConnConfig.test_config())
+    sw = Switch(transport, SwitchConfig(), mconfig or MConnConfig.test_config(),
+                metrics=metrics)
     if init_switch is not None:
         ret = init_switch(idx, sw)
         if isinstance(ret, Switch):
@@ -85,6 +87,44 @@ def connect_switches(sw1: Switch, sw2: Switch) -> None:
         sw._add_peer(
             UpgradedConn(
                 conn=sconn,
+                node_info=ni,
+                socket_addr=NetAddress(ni.id, "127.0.0.1", 1 + i),
+                outbound=outbound,
+            )
+        )
+
+
+def connect_switches_plain(sw1: Switch, sw2: Switch) -> None:
+    """Like connect_switches but over bare RawConns — NO SecretConnection,
+    so it runs on hosts without the `cryptography` package.  The NodeInfo
+    handshake works over any conn exposing write/read_exactly; everything
+    above the transport (Switch, Peer, MConnection, metrics) is identical
+    to the authenticated path."""
+    s1, s2 = socket.socketpair()
+    results: List = [None, None]
+    errors: List = [None, None]
+
+    def _upgrade(i: int, sw: Switch, sock) -> None:
+        try:
+            conn = RawConn(sock)
+            ni = sw.transport._exchange_node_info(conn)
+            ni.validate()
+            results[i] = (conn, ni)
+        except Exception as e:  # surfaced below
+            errors[i] = e
+
+    t1 = threading.Thread(target=_upgrade, args=(0, sw1, s1), daemon=True)
+    t2 = threading.Thread(target=_upgrade, args=(1, sw2, s2), daemon=True)
+    t1.start(), t2.start()
+    t1.join(10), t2.join(10)
+    for e in errors:
+        if e is not None:
+            raise e
+    for i, (sw, outbound) in enumerate(((sw1, True), (sw2, False))):
+        conn, ni = results[i]
+        sw._add_peer(
+            UpgradedConn(
+                conn=conn,
                 node_info=ni,
                 socket_addr=NetAddress(ni.id, "127.0.0.1", 1 + i),
                 outbound=outbound,

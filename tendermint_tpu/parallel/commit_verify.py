@@ -38,6 +38,7 @@ except AttributeError:
 
 from tendermint_tpu.libs import trace
 from tendermint_tpu.libs.metrics import get_verify_metrics
+from tendermint_tpu.libs.profile import get_profiler
 from tendermint_tpu.ops import ed25519_verify as _k
 
 SigTuple = Tuple[bytes, bytes, bytes]  # (pubkey32, msg, sig64)
@@ -55,6 +56,7 @@ class CommitWindow:
     r_sign: np.ndarray  # (H, V) uint32
     present: np.ndarray  # (H, V) bool — vote present AND host-side prechecks ok
     power: np.ndarray  # (H, V) int64 voting power (0 where absent)
+    pack_seconds: float = 0.0  # host pack wall time (cost ledger)
 
     @property
     def shape(self):
@@ -66,6 +68,7 @@ def pack_commit_window(
     powers: Sequence[Sequence[int]],
 ) -> CommitWindow:
     """votes[h][v] = (pub, msg, sig) or None (absent/nil); powers[h][v] int."""
+    t_pack = time.perf_counter()
     H = len(votes)
     V = max((len(row) for row in votes), default=0)
     z = np.zeros
@@ -112,6 +115,7 @@ def pack_commit_window(
         win.power[hs, vs] = np.where(
             valid, np.asarray(pows_l, dtype=np.int64), 0
         )
+    win.pack_seconds = time.perf_counter() - t_pack
     return win
 
 
@@ -204,12 +208,24 @@ def verify_commit_window(
                 *arrs, np.int64(total_power)
             )
             ok = np.asarray(ok)[:H, :V]
+    dt = time.perf_counter() - t0
     try:
         # rejects = votes that passed host prechecks but failed the device
         # verify; first dispatch per mesh key carries the jit compile
         get_verify_metrics().record_dispatch(
-            backend, "ed25519", n, time.perf_counter() - t0,
+            backend, "ed25519", n, dt,
             rejects=int(np.count_nonzero(win.present & ~ok)), first=first,
+        )
+        get_profiler().record(
+            backend,
+            bucket=(ph, pv),
+            lanes_present=n,
+            lanes_dispatched=ph * pv,
+            heights=H,
+            pack_seconds=win.pack_seconds,
+            run_seconds=dt,
+            compiled=first,
+            bytes_to_device=sum(a.nbytes for a in arrs),
         )
     except Exception:
         pass

@@ -56,6 +56,7 @@ import numpy as np
 
 from tendermint_tpu.libs import trace
 from tendermint_tpu.libs.metrics import get_verify_metrics
+from tendermint_tpu.libs.profile import get_profiler
 
 # (pubkey: PubKey object or raw 32-byte ed25519 key, msg, sig) or None
 SigTuple = Tuple[object, bytes, bytes]
@@ -119,6 +120,7 @@ class WindowPlan:
     totals: np.ndarray  # (H,) int64 per-height total voting power
     dev: Optional[tuple] = None  # padded device tensors (pack_device)
     dev_shape: Optional[Tuple[int, int]] = None  # (lane bucket, seg bucket)
+    pack_seconds: float = 0.0  # host plan+pack wall time (cost ledger)
 
     @property
     def n_lanes(self) -> int:
@@ -378,15 +380,27 @@ def _execute_device(plan: WindowPlan, mesh=None) -> WindowVerdict:
             tally = np.asarray(tally)[: plan.H]
             committed = np.asarray(committed)[: plan.H]
             nbad = np.asarray(nbad)[: plan.H]
+    dt = time.perf_counter() - t0
     try:
         m = get_verify_metrics()
         m.record_planner(n, B, compiled=compiled)
         # rejects = lanes that passed the host prechecks but failed the
         # device verify (same definition as commit_verify)
         m.record_dispatch(
-            backend, "ed25519", n, time.perf_counter() - t0,
+            backend, "ed25519", n, dt,
             rejects=int(np.count_nonzero(plan.dev[6][:n] & ~ok_l)),
             first=compiled,
+        )
+        get_profiler().record(
+            backend,
+            bucket=(B, S),
+            lanes_present=n,
+            lanes_dispatched=B,
+            heights=plan.H,
+            pack_seconds=plan.pack_seconds,
+            run_seconds=dt,
+            compiled=compiled,
+            bytes_to_device=sum(a.nbytes for a in plan.dev),
         )
     except Exception:
         pass
@@ -418,6 +432,7 @@ def _execute_host(plan: WindowPlan, verifier=None) -> WindowVerdict:
     from tendermint_tpu.crypto.batch import verify_generic
     from tendermint_tpu.crypto.keys import PubKey, PubKeyEd25519
 
+    t0 = time.perf_counter()
     n = plan.n_lanes
     ok_l = np.zeros((n,), dtype=bool)
     if n:
@@ -447,6 +462,19 @@ def _execute_host(plan: WindowPlan, verifier=None) -> WindowVerdict:
     ok = np.zeros((plan.H, plan.V), dtype=bool)
     if n:
         ok[plan.coords[:, 0], plan.coords[:, 1]] = ok_l
+    try:
+        # the host path is a real dispatch too (it IS the production path
+        # without a mesh) — ledger it so dump_profile never comes up empty
+        get_profiler().record(
+            "host",
+            lanes_present=n,
+            lanes_dispatched=0,
+            heights=plan.H,
+            pack_seconds=plan.pack_seconds,
+            run_seconds=time.perf_counter() - t0,
+        )
+    except Exception:
+        pass
     return WindowVerdict(
         ok=ok,
         tally=tally,
@@ -480,12 +508,14 @@ def verify_window(
     use_device: Optional[bool] = None,
 ) -> WindowVerdict:
     """plan + execute in one call — the synchronous entry point."""
+    t0 = time.perf_counter()
     with trace.span("planner.pack", H=len(votes)):
         plan = plan_window(votes, powers, totals)
         if (use_device or (use_device is None and mesh is not None)) and (
             plan.all_ed25519()
         ):
             pack_device(plan, mesh)
+    plan.pack_seconds = time.perf_counter() - t0
     return execute_plan(plan, mesh=mesh, verifier=verifier, use_device=use_device)
 
 
@@ -556,6 +586,7 @@ class WindowPipeline:
                 for votes, powers, totals in specs:
                     if stop.is_set():
                         return
+                    t0 = time.perf_counter()
                     with trace.span("planner.pack", H=len(votes)):
                         plan = plan_window(votes, powers, totals)
                         dev = use_device if use_device is not None else (
@@ -563,6 +594,7 @@ class WindowPipeline:
                         )
                         if dev and plan.all_ed25519():
                             pack_device(plan, mesh)
+                    plan.pack_seconds = time.perf_counter() - t0
                     if not _put(("plan", plan)):
                         return
             except BaseException as e:  # re-raised on the consumer side

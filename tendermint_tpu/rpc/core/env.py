@@ -521,6 +521,34 @@ class RPCEnv:
             "capacity": trace.get_tracer().capacity,
         }
 
+    def dump_profile(self) -> dict:
+        """Snapshot the device-dispatch cost ledger: per-window rows of
+        host pack / compile / device run seconds, bytes shipped, and lane
+        occupancy (libs/profile.py).  Gated like dump_trace — the ledger
+        leaks internal timings."""
+        self._require_unsafe()
+        from tendermint_tpu.libs.profile import get_profiler
+
+        p = get_profiler()
+        return {
+            "ledger": p.ledger(),
+            "entries": p.entries(),
+            "dropped": p.dropped,
+        }
+
+    def profile_reset(self, capacity=None) -> dict:
+        """Clear the dispatch-cost ledger; optionally resize the ring
+        (capacity=N)."""
+        self._require_unsafe()
+        from tendermint_tpu.libs.profile import get_profiler
+
+        if capacity is not None:
+            capacity = int(capacity)
+            if capacity < 1:
+                raise RPCError(-32602, "capacity must be >= 1")
+        get_profiler().reset(capacity)
+        return {}
+
     def unsafe_dump_threads(self) -> dict:
         """Stack dump of every live thread — the pprof-goroutine analogue
         (ref: pprof server at node/node.go:474-479)."""

@@ -3,7 +3,10 @@
 
 Tracks N nodes over RPC + websocket NewBlock events: per-node height,
 latency, uptime %, and network-wide health (all nodes online + heights in
-agreement). Renders a refreshing table, or JSON snapshots with --json.
+agreement). A /metrics scrape per poll feeds verify-dispatch latency and
+p2p traffic columns. Renders a refreshing table, or JSON snapshots with
+--json; offline nodes carry the last error and downtime duration instead
+of silently flipping `online`.
 
 Usage:
     python -m tendermint_tpu.tools.tm_monitor tcp://127.0.0.1:26657,tcp://...
@@ -12,13 +15,50 @@ Usage:
 from __future__ import annotations
 
 import argparse
+import http.client
 import json
 import sys
 import threading
 import time
 from typing import Dict, List, Optional
+from urllib.parse import urlparse
 
 from tendermint_tpu.rpc.client import HTTPClient, WSEventClient
+
+
+def _scrape_metrics(addr: str, timeout: float = 3.0) -> Dict[str, float]:
+    """Raw GET of /metrics (the JSON-RPC client can't — exposition is plain
+    text).  Returns {metric_key: value} where labeled series key as
+    `name{labels}`; histograms contribute their _sum/_count series."""
+    u = urlparse(addr if "//" in addr else f"tcp://{addr}")
+    conn = http.client.HTTPConnection(u.hostname, u.port, timeout=timeout)
+    try:
+        conn.request("GET", "/metrics")
+        resp = conn.getresponse()
+        if resp.status != 200:
+            return {}
+        text = resp.read().decode("utf-8", "replace")
+    finally:
+        conn.close()
+    out: Dict[str, float] = {}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        try:
+            key, val = line.rsplit(None, 1)
+            out[key] = float(val)
+        except ValueError:
+            continue
+    return out
+
+
+def _sum_family(metrics: Dict[str, float], name: str) -> float:
+    """Total across every series of a (possibly labeled) family."""
+    total = 0.0
+    for k, v in metrics.items():
+        if k == name or k.startswith(name + "{"):
+            total += v
+    return total
 
 
 class NodeMonitor:
@@ -31,6 +71,12 @@ class NodeMonitor:
         self.network = "?"
         self.height = 0
         self.block_latency_ms = 0.0
+        # offline diagnostics: why and since when (monotonic)
+        self.last_error: Optional[str] = None
+        self.offline_since: Optional[float] = None
+        # hot-path columns from /metrics
+        self.verify_ms = 0.0  # avg verify-dispatch latency
+        self.traffic_bytes = 0.0  # total per-peer send+recv wire bytes
         self._last_block_at: Optional[float] = None
         self._started = time.monotonic()
         self._online_time = 0.0
@@ -51,15 +97,36 @@ class NodeMonitor:
                 if self.online:
                     self._online_time += now - self._last_poll
                 self.online = True
+                self.last_error = None
+                self.offline_since = None
+                self._scrape()
                 if self._ws is None:
                     self._connect_ws()
-            except Exception:
+            except Exception as e:
+                if self.online or self.offline_since is None:
+                    self.offline_since = now
                 self.online = False
+                self.last_error = f"{type(e).__name__}: {e}"
                 if self._ws is not None:
                     self._ws.close()  # else the socket + watcher thread leak
                     self._ws = None
             self._last_poll = now
             self._stop.wait(1.0)
+
+    def _scrape(self) -> None:
+        """Best-effort /metrics poll for the latency/traffic columns —
+        a node with prometheus disabled just shows zeros."""
+        try:
+            m = _scrape_metrics(self.addr)
+        except Exception:
+            return
+        s = _sum_family(m, "tendermint_verify_dispatch_seconds_sum")
+        c = _sum_family(m, "tendermint_verify_dispatch_seconds_count")
+        if c > 0:
+            self.verify_ms = round(1e3 * s / c, 1)
+        self.traffic_bytes = _sum_family(
+            m, "tendermint_p2p_peer_send_bytes_total"
+        ) + _sum_family(m, "tendermint_p2p_peer_receive_bytes_total")
 
     def _connect_ws(self) -> None:
         try:
@@ -91,14 +158,24 @@ class NodeMonitor:
         total = time.monotonic() - self._started
         return round(100.0 * self._online_time / total, 1) if total > 0 else 0.0
 
+    @property
+    def downtime_s(self) -> Optional[float]:
+        if self.offline_since is None:
+            return None
+        return round(time.monotonic() - self.offline_since, 1)
+
     def snapshot(self) -> dict:
         return {
             "addr": self.addr,
             "moniker": self.moniker,
             "network": self.network,
             "online": self.online,
+            "last_error": self.last_error,
+            "downtime_s": self.downtime_s,
             "height": self.height,
             "block_interval_ms": self.block_latency_ms,
+            "verify_ms": self.verify_ms,
+            "traffic_bytes": self.traffic_bytes,
             "uptime_pct": self.uptime_pct,
         }
 
@@ -139,6 +216,14 @@ class NetworkMonitor:
             n.stop()
 
 
+def _fmt_bytes(n: float) -> str:
+    for unit in ("B", "KB", "MB", "GB"):
+        if n < 1024 or unit == "GB":
+            return f"{n:.0f}{unit}" if unit == "B" else f"{n:.1f}{unit}"
+        n /= 1024
+    return f"{n:.1f}GB"
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("endpoints", help="comma-separated tcp://host:port list")
@@ -159,12 +244,23 @@ def main(argv=None) -> int:
                 print(f"\nnetwork: {snap['health']}  "
                       f"({snap['num_online']}/{snap['num_nodes']} online, "
                       f"height {snap['max_height']})")
-                print(f"{'MONIKER':<16}{'HEIGHT':>8}{'INTERVAL':>10}{'UPTIME':>8}  ADDR")
+                print(f"{'MONIKER':<16}{'HEIGHT':>8}{'INTERVAL':>10}"
+                      f"{'VERIFY':>9}{'TRAFFIC':>10}{'UPTIME':>8}  ADDR")
                 for n in snap["nodes"]:
+                    if n["online"]:
+                        suffix = ""
+                    else:
+                        why = n["last_error"] or "unreachable"
+                        down = n["downtime_s"]
+                        dur = f" {down:.0f}s" if down is not None else ""
+                        suffix = f"  (OFFLINE{dur}: {why})"
                     print(
                         f"{n['moniker']:<16}{n['height']:>8}"
-                        f"{n['block_interval_ms']:>9}ms{n['uptime_pct']:>7}%  "
-                        f"{n['addr']}{'' if n['online'] else '  (OFFLINE)'}"
+                        f"{n['block_interval_ms']:>9}ms"
+                        f"{n['verify_ms']:>7}ms"
+                        f"{_fmt_bytes(n['traffic_bytes']):>10}"
+                        f"{n['uptime_pct']:>7}%  "
+                        f"{n['addr']}{suffix}"
                     )
             i += 1
             if args.iterations and i >= args.iterations:

@@ -369,3 +369,221 @@ class TestMetricsLint:
         m = NodeMetrics()
         m.record_block(_FakeBlock(2), _FakeValset())
         assert lint.lint_text(m.registry.expose_text()) == []
+
+
+# -- hot-path families (per-peer traffic, timing histograms, mempool) ---------------
+
+
+class TestHotPathFamilies:
+    def test_new_families_expose_and_lint(self):
+        lint = _load_metrics_lint()
+        m = NodeMetrics()
+        m.step_duration.observe(0.01, ("NEW_ROUND",))
+        m.vote_arrival_latency.observe(0.002, ("prevote",))
+        m.wal_append_seconds.observe(0.0001)
+        m.wal_fsync_seconds.observe(0.003)
+        m.mempool_tx_size_bytes.observe(512.0)
+        m.mempool_failed_txs.add(1)
+        m.mempool_recheck_times.add(3)
+        text = m.registry.expose_text()
+        for needle in (
+            '# TYPE tendermint_consensus_step_duration_seconds histogram',
+            'tendermint_consensus_step_duration_seconds_count{step="NEW_ROUND"} 1',
+            'tendermint_consensus_vote_arrival_latency_seconds_count{type="prevote"} 1',
+            "tendermint_consensus_wal_append_seconds_count 1",
+            "tendermint_consensus_wal_fsync_seconds_count 1",
+            "tendermint_mempool_tx_size_bytes_count 1",
+            "tendermint_mempool_failed_txs 1",
+            "tendermint_mempool_recheck_times 3",
+        ):
+            assert needle in text, needle
+        assert lint.lint_text(text) == []
+
+    def test_peer_traffic_labels_and_forget(self):
+        m = NodeMetrics()
+        m.record_peer_traffic("aa" * 20, 0x40, sent=100, received=50)
+        m.record_peer_traffic("aa" * 20, 0x20, sent=7)
+        m.set_peer_pending("aa" * 20, 42)
+        text = m.registry.expose_text()
+        assert (
+            'tendermint_p2p_peer_send_bytes_total{peer_id="' + "aa" * 20
+            + '",chID="0x40"} 100' in text
+        )
+        assert (
+            'tendermint_p2p_peer_receive_bytes_total{peer_id="' + "aa" * 20
+            + '",chID="0x40"} 50' in text
+        )
+        assert (
+            'tendermint_p2p_peer_pending_send_bytes{peer_id="' + "aa" * 20
+            + '"} 42' in text
+        )
+        m.forget_peer("aa" * 20)
+        text = m.registry.expose_text()
+        assert "aa" * 20 not in text
+        # TYPE lines survive so the scrape stays lintable
+        assert "# TYPE tendermint_p2p_peer_send_bytes_total counter" in text
+
+    def test_peer_label_cardinality_cap(self):
+        m = NodeMetrics()
+        for i in range(NodeMetrics.MAX_PEER_LABELS + 8):
+            m.record_peer_traffic(f"{i:040x}", 0x40, sent=1)
+        labels = {k[0] for k in m.peer_send_bytes._values}
+        assert "overflow" in labels
+        # cap + the shared overflow label bounds the series count
+        assert len(labels) == NodeMetrics.MAX_PEER_LABELS + 1
+        # overflow absorbed the excess peers' bytes
+        assert m.peer_send_bytes._values[("overflow", "0x40")] == 8.0
+        # forgetting a capped peer frees a slot for a new id
+        victim = f"{0:040x}"
+        m.forget_peer(victim)
+        m.record_peer_traffic("ff" * 20, 0x40, sent=1)
+        assert ("ff" * 20, "0x40") in m.peer_send_bytes._values
+
+    def test_remove_matching_counts_and_ignores_unknown_label(self):
+        m = NodeMetrics()
+        m.record_peer_traffic("ab" * 20, 0x40, sent=1)
+        m.record_peer_traffic("ab" * 20, 0x20, sent=1)
+        assert m.peer_send_bytes.remove_matching("peer_id", "ab" * 20) == 2
+        assert m.peer_send_bytes.remove_matching("peer_id", "ab" * 20) == 0
+        assert m.peer_send_bytes.remove_matching("nope", "x") == 0
+
+
+# -- dispatch-cost profiler ---------------------------------------------------------
+
+
+class TestProfiler:
+    def _p(self, capacity=8):
+        from tendermint_tpu.libs.profile import Profiler
+
+        return Profiler(capacity=capacity)
+
+    def test_window_annotation_and_nesting(self):
+        p = self._p()
+        with p.window(100, heights=4):
+            p.record("pallas", lanes_present=3, lanes_dispatched=4)
+            with p.window(200):
+                p.record("host")
+            p.record("pallas")
+        p.record("host")  # un-annotated
+        es = p.entries()
+        assert [e["height_base"] for e in es] == [100, 200, 100, None]
+        assert es[0]["heights"] == 4
+        assert es[0]["occupancy"] == 0.75
+
+    def test_ledger_folds_by_window(self):
+        p = self._p()
+        with p.window(50, heights=8):
+            p.record("pallas", bucket=(4, 16), lanes_present=3,
+                     lanes_dispatched=4, pack_seconds=0.1, run_seconds=0.2,
+                     compiled=True, bytes_to_device=1000)
+            p.record("pallas", bucket=(4, 16), lanes_present=4,
+                     lanes_dispatched=4, pack_seconds=0.1, run_seconds=0.05,
+                     bytes_to_device=1000)
+        p.record("host", run_seconds=0.01)
+        rows = p.ledger()
+        assert len(rows) == 2
+        win = rows[0]
+        assert win["height_base"] == 50
+        assert win["dispatches"] == 2
+        assert win["buckets"] == [[4, 16]]
+        assert win["compiles"] == 1
+        assert win["compile_seconds"] == pytest.approx(0.2)
+        assert win["pack_seconds"] == pytest.approx(0.2)
+        assert win["run_seconds"] == pytest.approx(0.25)
+        assert win["bytes_to_device"] == 2000
+        assert win["occupancy"] == pytest.approx(7 / 8)
+        assert rows[1]["height_base"] is None
+        assert rows[1]["dispatches"] == 1
+
+    def test_ring_eviction_and_reset(self):
+        p = self._p(capacity=4)
+        for i in range(10):
+            p.record("host")
+        assert len(p.entries()) == 4
+        assert p.dropped == 6
+        assert [e["seq"] for e in p.entries()] == [6, 7, 8, 9]
+        p.reset(capacity=2)
+        assert p.entries() == []
+        assert p.dropped == 0
+        p.record("host"), p.record("host"), p.record("host")
+        assert len(p.entries()) == 2
+
+    def test_verify_window_records_ledger(self):
+        """Acceptance: a fast-sync window verify leaves a non-empty
+        per-height ledger behind (the dump_profile RPC serves exactly
+        this)."""
+        from tendermint_tpu.blockchain.reactor import verify_block_window
+        from tendermint_tpu.libs.profile import get_profiler
+        from tendermint_tpu.state.state_types import state_from_genesis
+        from tendermint_tpu.testutil.chain import build_chain
+
+        fx = build_chain(n_vals=2, n_heights=6, chain_id="prof-ledger")
+        blocks = [fx.block_store.load_block(h) for h in range(1, 7)]
+        st = state_from_genesis(fx.genesis)
+        p = get_profiler()
+        p.reset()
+        n_ok, err = verify_block_window(st, blocks)
+        assert err is None and n_ok == 5
+        rows = p.ledger()
+        assert rows, "window verify must record dispatch-cost entries"
+        row = rows[0]
+        assert row["height_base"] == 1
+        assert row["heights"] >= 1
+        assert row["dispatches"] >= 1
+        assert row["run_seconds"] > 0
+        assert row["pack_seconds"] >= 0
+        assert "occupancy" in row and "bytes_to_device" in row
+        p.reset()
+
+
+# -- bench regression gate ----------------------------------------------------------
+
+
+def _load_bench_check():
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "scripts", "bench_check.py",
+    )
+    spec = importlib.util.spec_from_file_location("bench_check", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class TestBenchCheck:
+    @pytest.fixture(scope="class")
+    def bc(self):
+        return _load_bench_check()
+
+    @staticmethod
+    def _write(tmp, n, value):
+        parsed = None if value is None else {"fastsync_blocks_per_s": value}
+        with open(os.path.join(tmp, f"BENCH_r{n:02d}.json"), "w") as f:
+            json.dump({"round": n, "parsed": parsed}, f)
+
+    def test_ok_within_threshold(self, bc, tmp_path):
+        self._write(tmp_path, 1, 100.0)
+        self._write(tmp_path, 2, 90.0)
+        assert bc.check(str(tmp_path), 0.20) == 0
+
+    def test_regression_fails(self, bc, tmp_path):
+        self._write(tmp_path, 1, 100.0)
+        self._write(tmp_path, 2, 70.0)
+        assert bc.check(str(tmp_path), 0.20) == 1
+
+    def test_null_parsed_rounds_skipped(self, bc, tmp_path):
+        self._write(tmp_path, 1, 100.0)
+        self._write(tmp_path, 2, None)  # timed out round
+        self._write(tmp_path, 3, 95.0)
+        # r02 is skipped; r03 vs r01 is within threshold
+        assert bc.check(str(tmp_path), 0.20) == 0
+
+    def test_newest_unparsed_skips(self, bc, tmp_path):
+        self._write(tmp_path, 1, 100.0)
+        self._write(tmp_path, 2, None)
+        assert bc.check(str(tmp_path), 0.20) == 0
+
+    def test_no_baseline_passes(self, bc, tmp_path):
+        self._write(tmp_path, 1, 100.0)
+        assert bc.check(str(tmp_path), 0.20) == 0
+        assert bc.check(str(tmp_path / "empty-missing"), 0.20) == 0

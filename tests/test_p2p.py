@@ -27,6 +27,7 @@ from tendermint_tpu.p2p.conn.secret_connection import RawConn, SecretConnection
 from tendermint_tpu.p2p.errors import RejectedError
 from tendermint_tpu.p2p.test_util import (
     connect_switches,
+    connect_switches_plain,
     make_connected_switches,
     make_switch,
     stop_switches,
@@ -433,6 +434,135 @@ class TestSwitch:
             peer0.mconn._conn.close()
             assert _wait_until(lambda: sws[0].peers.size() == 0)
             assert _wait_until(lambda: sws[1].peers.size() == 0)
+        finally:
+            stop_switches(sws)
+
+
+# ---------------------------------------------------------------------------
+# Per-peer traffic metrics (satellite: byte counters reconcile with the
+# flowrate monitors; ref p2p/metrics.go PeerReceiveBytesTotal et al.)
+# ---------------------------------------------------------------------------
+
+
+def _quiet_mconfig():
+    """Test-speed flush but the default 60s ping interval: pings are
+    monitor-counted but not channel-attributed, so the per-channel-sum ==
+    monitor-total assertions need a ping-free run (test_config pings
+    every 0.4s)."""
+    return MConnConfig(
+        send_rate=5_120_000, recv_rate=5_120_000, flush_throttle=0.01
+    )
+
+
+class TestPeerTrafficMetrics:
+    """Crypto-free: the pair is wired over plain RawConns
+    (connect_switches_plain), so only the SecretConnection leg of the p2p
+    stack is skipped — Switch, Peer, MConnection, and the metrics hooks
+    all run for real."""
+
+    def _make_pair(self):
+        from tendermint_tpu.libs.metrics import NodeMetrics
+
+        reactors, metrics = {}, {}
+
+        def init(i, sw):
+            reactors[i] = sw.add_reactor("echo", EchoReactor())
+            return sw
+
+        sws = []
+        for i in range(2):
+            metrics[i] = NodeMetrics()
+            sws.append(
+                make_switch(
+                    i,
+                    init_switch=lambda idx, sw, i=i: init(i, sw),
+                    mconfig=_quiet_mconfig(),
+                    metrics=metrics[i],
+                )
+            )
+        for sw in sws:
+            sw.start()
+        connect_switches_plain(sws[0], sws[1])
+        return sws, reactors, metrics
+
+    @staticmethod
+    def _chan_sum(counter, peer_id):
+        return sum(
+            v
+            for labels, v in counter._values.items()
+            if labels[0] == peer_id
+        )
+
+    def test_per_peer_counters_match_flowrate_monitors(self):
+        sws, reactors, metrics = self._make_pair()
+        try:
+            peer0 = sws[0].peers.list()[0]  # sw0's view of sw1
+            peer1 = sws[1].peers.list()[0]
+            for i in range(4):
+                assert peer0.send(0x10, b"marco-%d" % i)
+            assert _wait_until(lambda: len(reactors[0].received) == 4)
+
+            # both directions drained: each side's recv monitor has caught
+            # up with the opposite side's send monitor
+            def settled():
+                return (
+                    peer1.mconn._recv_monitor.status().bytes
+                    == peer0.mconn._send_monitor.status().bytes
+                    and peer0.mconn._recv_monitor.status().bytes
+                    == peer1.mconn._send_monitor.status().bytes
+                )
+
+            assert _wait_until(settled)
+
+            for sw_i, peer, other in ((0, peer0, sws[1]), (1, peer1, sws[0])):
+                m = metrics[sw_i]
+                sent = peer.mconn._send_monitor.status().bytes
+                recv = peer.mconn._recv_monitor.status().bytes
+                assert sent > 0 and recv > 0
+                assert self._chan_sum(m.peer_send_bytes, other.node_id) == sent
+                assert (
+                    self._chan_sum(m.peer_receive_bytes, other.node_id) == recv
+                )
+
+            # message-type counters: sw0 sent 4, received 4 echoes (and
+            # vice versa), all on channel 0x10
+            assert metrics[0].messages_sent._values[("0x10",)] == 4
+            assert metrics[0].messages_received._values[("0x10",)] == 4
+            assert metrics[1].messages_sent._values[("0x10",)] == 4
+            assert metrics[1].messages_received._values[("0x10",)] == 4
+        finally:
+            stop_switches(sws)
+
+    def test_pending_send_gauge_and_status(self):
+        sws, reactors, metrics = self._make_pair()
+        try:
+            peer0 = sws[0].peers.list()[0]
+            assert peer0.send(0x10, b"x" * 2048)
+            # drains to zero once the send routine has packetised it
+            assert _wait_until(lambda: peer0.pending_send_bytes() == 0)
+            metrics[0].set_peer_pending(peer0.id, peer0.pending_send_bytes())
+            assert (
+                metrics[0].peer_pending_send_bytes._values[(peer0.id,)] == 0.0
+            )
+            st = peer0.mconn.status()
+            assert st["channels"]["0x10"]["pending_bytes"] == 0
+        finally:
+            stop_switches(sws)
+
+    def test_disconnect_forgets_peer_labels(self):
+        sws, reactors, metrics = self._make_pair()
+        try:
+            peer0 = sws[0].peers.list()[0]
+            pid = peer0.id
+            assert peer0.send(0x10, b"marco")
+            assert _wait_until(lambda: reactors[0].received)
+            assert pid in metrics[0].registry.expose_text()
+            sws[0].stop_peer_for_error(peer0, "test")
+            assert _wait_until(lambda: sws[0].peers.size() == 0)
+            text = metrics[0].registry.expose_text()
+            assert pid not in text
+            # families survive series removal (TYPE lines stay lintable)
+            assert "# TYPE tendermint_p2p_peer_send_bytes_total " in text
         finally:
             stop_switches(sws)
 

@@ -157,5 +157,19 @@ class TestTools:
             assert snap["health"] == "moderate"  # one of two online
             assert snap["num_online"] == 1
             assert snap["nodes"][0]["moniker"] != "?"
+            # the dead node carries its failure forensics
+            assert wait_for(lambda: net.nodes[1].last_error is not None,
+                            timeout=20)
+            snap = net.snapshot()
+            dead = snap["nodes"][1]
+            assert dead["online"] is False
+            assert dead["last_error"]
+            assert dead["downtime_s"] is not None and dead["downtime_s"] >= 0
+            # the live node has no error and no downtime
+            alive = snap["nodes"][0]
+            assert alive["last_error"] is None
+            assert alive["downtime_s"] is None
+            # hot-path columns come from the /metrics scrape
+            assert "verify_ms" in alive and "traffic_bytes" in alive
         finally:
             net.stop()

@@ -307,3 +307,103 @@ class TestTraceExport:
             assert not trace.enabled()
         finally:
             live_node.config.rpc.unsafe = True
+
+
+class TestHotPathMetricsScrape:
+    def test_new_families_on_live_node(self, live_node):
+        """The hot-path families land on /metrics of a running node: the
+        consensus loop drives step_duration, the WAL drives fsync timings,
+        and a checked tx drives the mempool size histogram.  (No p2p peers
+        here, so the per-peer families expose TYPE lines only.)"""
+        assert wait_for(lambda: live_node.block_store.height() >= 2, timeout=30)
+        live_node.mempool.check_tx(b"hot-key=hot-val")
+        assert wait_for(
+            lambda: b"tendermint_mempool_tx_size_bytes_count 1"
+            in _rpc_get(live_node, "/metrics")[1],
+            timeout=15,
+        )
+        text = _rpc_get(live_node, "/metrics")[1].decode()
+        for needle in (
+            "# TYPE tendermint_consensus_step_duration_seconds histogram",
+            "# TYPE tendermint_consensus_vote_arrival_latency_seconds histogram",
+            "# TYPE tendermint_consensus_wal_append_seconds histogram",
+            "# TYPE tendermint_consensus_wal_fsync_seconds histogram",
+            "# TYPE tendermint_p2p_peer_receive_bytes_total counter",
+            "# TYPE tendermint_p2p_peer_send_bytes_total counter",
+            "# TYPE tendermint_p2p_peer_pending_send_bytes gauge",
+            "# TYPE tendermint_p2p_messages_received_total counter",
+            "# TYPE tendermint_p2p_messages_sent_total counter",
+            "# TYPE tendermint_mempool_tx_size_bytes histogram",
+            "# TYPE tendermint_mempool_failed_txs counter",
+            "# TYPE tendermint_mempool_recheck_times counter",
+            "# TYPE tendermint_consensus_rounds gauge",
+        ):
+            assert needle in text, f"missing {needle}"
+        # a committing node has left NEW_HEIGHT/COMMIT steps behind it
+        count_line = next(
+            l for l in text.splitlines()
+            if l.startswith("tendermint_consensus_step_duration_seconds_count")
+        )
+        assert float(count_line.split()[-1]) >= 1
+        # WAL fsyncs every commit
+        fsync_line = next(
+            l for l in text.splitlines()
+            if l.startswith("tendermint_consensus_wal_fsync_seconds_count")
+        )
+        assert float(fsync_line.split()[-1]) >= 1
+        # single-validator consensus signs prevotes+precommits each height
+        vote_line = next(
+            l for l in text.splitlines()
+            if l.startswith(
+                'tendermint_consensus_vote_arrival_latency_seconds_count'
+            )
+        )
+        assert float(vote_line.split()[-1]) >= 1
+
+
+class TestProfileExport:
+    def test_dump_profile_and_reset(self, live_node):
+        from tendermint_tpu.libs.profile import get_profiler
+
+        p = get_profiler()
+        p.reset()
+        try:
+            with p.window(42, heights=3):
+                p.record("pallas", bucket=(4, 16), lanes_present=3,
+                         lanes_dispatched=4, pack_seconds=0.01,
+                         run_seconds=0.2, compiled=True, bytes_to_device=512)
+            status, body = _rpc_get(live_node, "/dump_profile")
+            assert status == 200
+            out = json.loads(body)["result"]
+            assert out["dropped"] == 0
+            assert len(out["entries"]) == 1
+            row = out["ledger"][0]
+            assert row["height_base"] == 42
+            assert row["heights"] == 3
+            assert row["compiles"] == 1
+            assert row["bytes_to_device"] == 512
+            assert row["occupancy"] == 0.75
+            # reset clears and resizes the ring
+            _, body = _rpc_get(live_node, "/profile_reset?capacity=2")
+            assert "error" not in json.loads(body)
+            out = json.loads(_rpc_get(live_node, "/dump_profile")[1])["result"]
+            assert out["entries"] == [] and out["ledger"] == []
+            for _ in range(3):
+                p.record("host")
+            out = json.loads(_rpc_get(live_node, "/dump_profile")[1])["result"]
+            assert len(out["entries"]) == 2 and out["dropped"] == 1
+        finally:
+            p.reset()
+
+    def test_profile_reset_rejects_bad_capacity(self, live_node):
+        _, body = _rpc_get(live_node, "/profile_reset?capacity=0")
+        assert "error" in json.loads(body)
+
+    def test_profile_routes_gated(self, live_node):
+        live_node.config.rpc.unsafe = False
+        try:
+            for route in ("/dump_profile", "/profile_reset"):
+                _, body = _rpc_get(live_node, route)
+                assert "error" in json.loads(body)
+        finally:
+            live_node.config.rpc.unsafe = True
