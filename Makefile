@@ -4,7 +4,8 @@ PYTHON ?= python
 
 .PHONY: test test_slow test_sanitizers bench bench-local bench_fastsync \
         planner-bench bench_secp bench_multisig metrics-lint bench-check \
-        statesync-smoke localnet-start localnet-stop build-docker-localnode
+        statesync-smoke flight-smoke localnet-start localnet-stop \
+        build-docker-localnode
 
 test:
 	$(PYTHON) -m pytest tests/ -q
@@ -51,6 +52,12 @@ bench-check:
 # -> batched backfill) + linted tendermint_statesync_* scrape
 statesync-smoke:
 	JAX_PLATFORMS=cpu $(PYTHON) scripts/statesync_smoke.py
+
+# 4-node in-proc net with flight recorders on: forced >1/3 stall must trip
+# the liveness watchdog, and the merged per-node dump must validate as
+# Chrome trace-event JSON with agreeing commit anchors
+flight-smoke:
+	JAX_PLATFORMS=cpu $(PYTHON) scripts/flight_smoke.py
 
 build-docker-localnode:
 	docker build -t tendermint_tpu/localnode networks/local/localnode

@@ -1,11 +1,25 @@
 """Regression gate over the committed BENCH_r*.json ledger.
 
-Compares the newest round's `parsed.fastsync_blocks_per_s` against the most
-recent previous round that has one (rounds that timed out carry
-``parsed: null`` and are skipped) and exits 1 on a >20% drop.  Run it after
-a bench round, or via ``make bench-check``.
+Compares the newest round's parsed metrics against the most recent previous
+round that has each metric (rounds that timed out carry ``parsed: null`` and
+are skipped) and exits 1 on any regression beyond its threshold.  Run it
+after a bench round, or via ``make bench-check``.
 
-Usage: python scripts/bench_check.py [--threshold 0.20] [--dir REPO_ROOT]
+Metrics are specs of the form ``name[:threshold[:direction]]`` where
+direction is ``higher`` (default: a drop is a regression) or ``lower``
+(latency-style: a rise is a regression), e.g.::
+
+    python scripts/bench_check.py \
+        --metric fastsync_blocks_per_s:0.20:higher \
+        --metric verify_dispatch_ms:0.25:lower
+
+With no --metric the historical default gate
+(``fastsync_blocks_per_s:0.20:higher``) applies.  A metric missing from the
+newest round is reported and skipped — only metrics present in BOTH compared
+rounds gate.
+
+Usage: python scripts/bench_check.py [--metric SPEC]... [--threshold 0.20]
+                                     [--dir REPO_ROOT]
 """
 
 from __future__ import annotations
@@ -16,13 +30,49 @@ import json
 import os
 import re
 import sys
+from dataclasses import dataclass
+from typing import List, Optional
 
-METRIC = "fastsync_blocks_per_s"
+DEFAULT_METRIC = "fastsync_blocks_per_s"
 DEFAULT_THRESHOLD = 0.20
 
 
+@dataclass(frozen=True)
+class MetricSpec:
+    name: str
+    threshold: float
+    higher_is_better: bool
+
+    @classmethod
+    def parse(cls, spec: str, default_threshold: float) -> "MetricSpec":
+        parts = spec.split(":")
+        if not parts[0] or len(parts) > 3:
+            raise ValueError(f"bad metric spec {spec!r}")
+        threshold = default_threshold
+        if len(parts) >= 2 and parts[1] != "":
+            threshold = float(parts[1])
+            if not 0.0 < threshold < 1.0:
+                raise ValueError(
+                    f"threshold in {spec!r} must be in (0, 1), got {threshold}"
+                )
+        direction = parts[2] if len(parts) == 3 else "higher"
+        if direction not in ("higher", "lower"):
+            raise ValueError(
+                f"direction in {spec!r} must be 'higher' or 'lower'"
+            )
+        return cls(parts[0], threshold, direction == "higher")
+
+    def regression(self, prev: float, new: float) -> Optional[float]:
+        """Fractional regression beyond tolerance, or None if within it."""
+        if self.higher_is_better:
+            change = 1.0 - new / prev  # drop fraction
+        else:
+            change = new / prev - 1.0  # rise fraction
+        return change if change > self.threshold else None
+
+
 def load_rounds(root: str):
-    """[(round_number, path, blocks_per_s or None)] sorted oldest→newest."""
+    """[(round_number, path, parsed-dict or None)] sorted oldest→newest."""
     rounds = []
     for path in glob.glob(os.path.join(root, "BENCH_r*.json")):
         m = re.search(r"BENCH_r(\d+)\.json$", os.path.basename(path))
@@ -35,61 +85,90 @@ def load_rounds(root: str):
             print(f"bench-check: unreadable {path}: {e}", file=sys.stderr)
             continue
         parsed = data.get("parsed")
-        value = None
-        if isinstance(parsed, dict):
-            v = parsed.get(METRIC)
-            if isinstance(v, (int, float)):
-                value = float(v)
-        rounds.append((int(m.group(1)), path, value))
+        rounds.append((int(m.group(1)), path,
+                       parsed if isinstance(parsed, dict) else None))
     rounds.sort()
     return rounds
 
 
-def check(root: str, threshold: float) -> int:
+def _metric_value(parsed: Optional[dict], name: str) -> Optional[float]:
+    if not parsed:
+        return None
+    v = parsed.get(name)
+    return float(v) if isinstance(v, (int, float)) else None
+
+
+def check(root: str, specs: List[MetricSpec]) -> int:
     rounds = load_rounds(root)
     if not rounds:
         print("bench-check: no BENCH_r*.json files — nothing to compare")
         return 0
-    newest_n, newest_path, newest = rounds[-1]
-    if newest is None:
-        print(
-            f"bench-check: newest round r{newest_n:02d} has no {METRIC} "
-            f"(timed out / unparsed) — skipping"
+    newest_n, newest_path, newest_parsed = rounds[-1]
+    failed = 0
+    for spec in specs:
+        newest = _metric_value(newest_parsed, spec.name)
+        if newest is None:
+            print(
+                f"bench-check: newest round r{newest_n:02d} has no "
+                f"{spec.name} (timed out / unparsed) — skipping"
+            )
+            continue
+        prev = [
+            (n, _metric_value(parsed, spec.name))
+            for n, _, parsed in rounds[:-1]
+        ]
+        prev = [(n, v) for n, v in prev if v is not None]
+        if not prev:
+            print(
+                f"bench-check: r{newest_n:02d} {spec.name}={newest:g} — "
+                f"no earlier round to compare against"
+            )
+            continue
+        prev_n, prev_v = prev[-1]
+        if prev_v <= 0:
+            print(
+                f"bench-check: previous {spec.name}={prev_v:g} not positive "
+                f"— skipping"
+            )
+            continue
+        ratio = newest / prev_v
+        arrow = "higher=better" if spec.higher_is_better else "lower=better"
+        line = (
+            f"bench-check: {spec.name} r{prev_n:02d}={prev_v:g} → "
+            f"r{newest_n:02d}={newest:g} ({ratio:.2%} of previous, {arrow})"
         )
-        return 0
-    prev = [(n, p, v) for n, p, v in rounds[:-1] if v is not None]
-    if not prev:
-        print(
-            f"bench-check: r{newest_n:02d} {METRIC}={newest:g} — "
-            f"no earlier round to compare against"
-        )
-        return 0
-    prev_n, prev_path, prev_v = prev[-1]
-    if prev_v <= 0:
-        print(f"bench-check: previous value {prev_v:g} not positive — skipping")
-        return 0
-    ratio = newest / prev_v
-    drop = 1.0 - ratio
-    line = (
-        f"bench-check: {METRIC} r{prev_n:02d}={prev_v:g} → "
-        f"r{newest_n:02d}={newest:g} ({ratio:.2%} of previous)"
-    )
-    if drop > threshold:
-        print(f"{line} — REGRESSION beyond {threshold:.0%}", file=sys.stderr)
-        return 1
-    print(f"{line} — ok (threshold {threshold:.0%})")
-    return 0
+        if spec.regression(prev_v, newest) is not None:
+            print(f"{line} — REGRESSION beyond {spec.threshold:.0%}",
+                  file=sys.stderr)
+            failed += 1
+        else:
+            print(f"{line} — ok (threshold {spec.threshold:.0%})")
+    return 1 if failed else 0
 
 
 def main(argv=None) -> int:
-    p = argparse.ArgumentParser(description=__doc__)
+    p = argparse.ArgumentParser(
+        description=__doc__, formatter_class=argparse.RawDescriptionHelpFormatter
+    )
+    p.add_argument(
+        "--metric", action="append", default=None, metavar="SPEC",
+        help="name[:threshold[:direction]] — repeatable; direction is "
+             "'higher' (default) or 'lower'",
+    )
     p.add_argument("--threshold", type=float, default=DEFAULT_THRESHOLD,
-                   help="max allowed fractional drop (default 0.20)")
+                   help="default max fractional regression for specs that "
+                        "don't set their own (default 0.20)")
     p.add_argument("--dir", default=os.path.dirname(
         os.path.dirname(os.path.abspath(__file__))
     ), help="directory holding BENCH_r*.json")
     args = p.parse_args(argv)
-    return check(args.dir, args.threshold)
+    raw = args.metric or [DEFAULT_METRIC]
+    try:
+        specs = [MetricSpec.parse(s, args.threshold) for s in raw]
+    except ValueError as e:
+        print(f"bench-check: {e}", file=sys.stderr)
+        return 2
+    return check(args.dir, specs)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,328 @@
+"""Flight-recorder smoke test (`make flight-smoke`).
+
+Drives the whole observability tentpole end to end, in one process, on CPU:
+
+  1. build a 4-validator in-proc consensus net (real ConsensusStates over a
+     crypto-free event-bus gossip pump — the real Switch needs the
+     'cryptography' package for its handshake) with every node's flight
+     recorder enabled;
+  2. run consensus to a target height, then silence 2 of the 4 validators
+     (>1/3 of voting power) and require the liveness watchdog to report the
+     stall — naming the missing validators' cumulative power — and to
+     increment tendermint_consensus_stalls_total within one interval budget;
+  3. dump all four recorders, fuse them with scripts/trace_merge.py (commit
+     anchors -> per-node skew correction), and strict-validate the merged
+     output as Chrome trace-event JSON (metrics_lint.py's style: collect
+     every problem, not just the first);
+  4. lint the watchdog metrics exposition with the strict metrics_lint
+     parser.
+
+Exit code 0 means stamps, stall detection, merging, and validation all work
+end to end on this machine.
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _ROOT)
+sys.path.insert(0, os.path.join(_ROOT, "tests"))
+
+import trace_merge  # noqa: E402  (sibling script)
+from metrics_lint import lint_text  # noqa: E402  (sibling script)
+
+from consensus_harness import (  # noqa: E402  (tests/ dir on path)
+    make_cs_from_genesis,
+    make_genesis,
+    wait_for,
+)
+
+from tendermint_tpu.consensus.messages import (  # noqa: E402
+    BlockPartMessage,
+    ProposalMessage,
+    VoteMessage,
+)
+from tendermint_tpu.libs.metrics import NodeMetrics  # noqa: E402
+from tendermint_tpu.libs.watchdog import LivenessWatchdog  # noqa: E402
+from tendermint_tpu.state.state_types import state_from_genesis  # noqa: E402
+from tendermint_tpu.types.events import (  # noqa: E402
+    EVENT_COMPLETE_PROPOSAL,
+    EVENT_VOTE,
+    query_for_event,
+)
+
+N_VALS = 4
+TARGET_HEIGHT = 5
+STALL_BUDGET_S = 6.0
+
+
+class _Net:
+    """Event-bus gossip: each node's own votes and (when proposer) its
+    proposal+parts are forwarded to every other node with peer id
+    "node<i>", so per-peer flight attribution is exercised for real."""
+
+    def __init__(self):
+        doc, pvs = make_genesis(N_VALS)
+        st = state_from_genesis(doc)
+        by_addr = {pv.get_pub_key().address(): pv for pv in pvs}
+        sorted_pvs = [by_addr[v.address] for v in st.validators.validators]
+        self.silenced = set()
+        self.nodes = []
+        self._threads = []
+        for i in range(N_VALS):
+            cs, bus = make_cs_from_genesis(doc, sorted_pvs[i])
+            cs.flight.node_id = f"node{i}"
+            cs.flight.enable()
+            self.nodes.append((cs, bus, sorted_pvs[i].get_pub_key().address()))
+        for i in range(N_VALS):
+            self._pump(i)
+
+    def _pump(self, i):
+        cs, bus, own_addr = self.nodes[i]
+        votes = bus.subscribe(f"pump-votes-{i}", query_for_event(EVENT_VOTE),
+                              maxsize=256)
+        props = bus.subscribe(
+            f"pump-props-{i}", query_for_event(EVENT_COMPLETE_PROPOSAL),
+            maxsize=64,
+        )
+
+        def fanout(msg):
+            for j, (peer_cs, _, _) in enumerate(self.nodes):
+                if j != i:
+                    peer_cs.send_peer_msg(msg, f"node{i}")
+
+        def vote_loop():
+            import queue as _q
+
+            while True:
+                try:
+                    ev = votes.get(timeout=0.2)
+                except _q.Empty:
+                    if votes.cancelled.is_set():
+                        return
+                    continue
+                vote = ev.data.vote
+                # forward only our own signatures: received votes already
+                # reached everyone from their signer (loop-free gossip)
+                if vote.validator_address == own_addr and i not in self.silenced:
+                    fanout(VoteMessage(vote))
+
+        def prop_loop():
+            import queue as _q
+
+            while True:
+                try:
+                    ev = props.get(timeout=0.2)
+                except _q.Empty:
+                    if props.cancelled.is_set():
+                        return
+                    continue
+                rs = ev.data.round_state
+                if rs is None or rs.proposal is None:
+                    continue
+                # only the height's proposer ships the block; everyone else
+                # saw this event because the gossip delivered it to them
+                proposer = rs.validators.get_proposer()
+                if proposer.address != own_addr or i in self.silenced:
+                    continue
+                fanout(ProposalMessage(rs.proposal))
+                parts = rs.proposal_block_parts
+                for pi in range(parts.total):
+                    fanout(BlockPartMessage(rs.height, rs.round,
+                                            parts.get_part(pi)))
+
+        for fn, nm in ((vote_loop, "votes"), (prop_loop, "props")):
+            t = threading.Thread(target=fn, name=f"pump-{nm}-{i}", daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def start(self):
+        for cs, _, _ in self.nodes:
+            cs.start()
+
+    def stop(self):
+        for i, (cs, bus, _) in enumerate(self.nodes):
+            try:
+                bus.unsubscribe_all(f"pump-votes-{i}")
+                bus.unsubscribe_all(f"pump-props-{i}")
+            except Exception:
+                pass
+            try:
+                cs.stop()
+            except Exception:
+                pass
+            try:
+                bus.stop()
+            except Exception:
+                pass
+
+
+def validate_chrome_trace(merged, n_nodes, min_commits_per_node):
+    """metrics_lint-style strict validation: every problem collected."""
+    errors = []
+    try:
+        merged = json.loads(json.dumps(merged))
+    except (TypeError, ValueError) as e:
+        return [f"not JSON-serializable: {e}"]
+    events = merged.get("traceEvents")
+    if not isinstance(events, list) or not events:
+        return ["traceEvents missing or empty"]
+
+    named_pids = set()
+    commits_by_pid = {}
+    for n, ev in enumerate(events):
+        where = f"event {n}"
+        for key in ("name", "ph", "pid"):
+            if key not in ev:
+                errors.append(f"{where}: missing {key!r}")
+        ph = ev.get("ph")
+        if ph not in ("X", "i", "M"):
+            errors.append(f"{where}: unexpected ph {ph!r}")
+            continue
+        if ph == "M":
+            if ev.get("name") == "process_name":
+                named_pids.add(ev.get("pid"))
+            if "args" not in ev or "name" not in ev["args"]:
+                errors.append(f"{where}: M event without args.name")
+            continue
+        for key in ("tid", "ts"):
+            if key not in ev:
+                errors.append(f"{where}: missing {key!r}")
+        if not isinstance(ev.get("ts"), (int, float)):
+            errors.append(f"{where}: non-numeric ts {ev.get('ts')!r}")
+        if ph == "X":
+            if not isinstance(ev.get("dur"), (int, float)) or ev["dur"] < 0:
+                errors.append(f"{where}: X event bad dur {ev.get('dur')!r}")
+        if ph == "i" and ev.get("s") not in ("t", "p", "g"):
+            errors.append(f"{where}: instant without scope 's'")
+        if ev.get("name") == "commit":
+            commits_by_pid[ev["pid"]] = commits_by_pid.get(ev["pid"], 0) + 1
+
+    for pid in range(n_nodes):
+        if pid not in named_pids:
+            errors.append(f"pid {pid}: no process_name metadata")
+        got = commits_by_pid.get(pid, 0)
+        if got < min_commits_per_node:
+            errors.append(
+                f"pid {pid}: only {got} commit instants "
+                f"(need >= {min_commits_per_node})"
+            )
+    return errors
+
+
+def main() -> int:
+    failures = []
+    net = _Net()
+    metrics = NodeMetrics()
+    watchdog = None
+    try:
+        net.start()
+        print(f"[flight-smoke] running {N_VALS}-node net to height "
+              f"{TARGET_HEIGHT}...")
+        ok = wait_for(
+            lambda: all(cs.rs.height > TARGET_HEIGHT
+                        for cs, _, _ in net.nodes),
+            timeout=60.0,
+        )
+        if not ok:
+            heights = [cs.rs.height for cs, _, _ in net.nodes]
+            return _fail([f"net never reached height {TARGET_HEIGHT + 1}: "
+                          f"heights={heights}"])
+        heights = [cs.rs.height for cs, _, _ in net.nodes]
+        # start the watchdog only after warm-up (the first heights pay JAX
+        # compile costs that would show up as a bogus "stall")
+        watchdog = LivenessWatchdog(
+            net.nodes[0][0],
+            metrics=metrics,
+            interval=0.2,
+            stall_factor=3.0,
+            min_stall_seconds=1.5,
+        )
+        watchdog.start()
+        time.sleep(1.0)  # a few healthy samples to seed the interval EWMA
+        print(f"[flight-smoke] heights={heights}; "
+              f"silencing validators 2 and 3 (>1/3 power)")
+
+        net.silenced.update({2, 3})
+        t0 = time.monotonic()
+        stalled = wait_for(
+            lambda: watchdog.report() is not None, timeout=STALL_BUDGET_S
+        )
+        if not stalled:
+            failures.append(
+                f"watchdog reported no stall within {STALL_BUDGET_S}s"
+            )
+        else:
+            report = watchdog.report()
+            print(f"[flight-smoke] stall detected after "
+                  f"{time.monotonic() - t0:.1f}s at h={report['height']} "
+                  f"r={report['round']} step={report['step']}")
+            missing = report["missing_precommits"]
+            if missing["total_power"] <= 0:
+                failures.append("stall report has no total power")
+            elif missing["power"] * 3 < missing["total_power"]:
+                failures.append(
+                    f"stall report names only {missing['power']}/"
+                    f"{missing['total_power']} missing power (< 1/3)"
+                )
+            missing_idx = {v["index"] for v in missing["validators"]}
+            if not missing_idx:
+                failures.append("stall report names no missing validators")
+        text = metrics.registry.expose_text()
+        if "tendermint_consensus_stalls_total 1" not in text:
+            failures.append(
+                "tendermint_consensus_stalls_total != 1 in exposition"
+            )
+        lint_errors = lint_text(text)
+        failures.extend(f"metrics_lint: {e}" for e in lint_errors)
+
+        print("[flight-smoke] dumping + merging flight records...")
+        dumps = [cs.flight.snapshot() for cs, _, _ in net.nodes]
+        for d, (cs, _, _) in zip(dumps, net.nodes):
+            if not d["records"]:
+                failures.append(f"{d['node_id']}: no flight records")
+        skews = trace_merge.compute_skews(dumps)
+        merged = trace_merge.merge(dumps, skews=skews)
+        failures.extend(
+            validate_chrome_trace(merged, N_VALS,
+                                  min_commits_per_node=TARGET_HEIGHT - 1)
+        )
+        spread = trace_merge.anchor_spread(dumps, skews)
+        if len(spread) < TARGET_HEIGHT - 1:
+            failures.append(
+                f"only {len(spread)} shared commit heights across nodes"
+            )
+        worst = max(spread.values()) if spread else 0.0
+        if worst > 0.25:
+            failures.append(
+                f"anchor spread {worst:.3f}s after skew correction (> 0.25s)"
+            )
+        out_path = os.path.join(_ROOT, "merged_trace.json")
+        with open(out_path, "w") as f:
+            json.dump(merged, f)
+        print(f"[flight-smoke] merged {len(merged['traceEvents'])} events "
+              f"-> {out_path}; skews_ns={skews} "
+              f"worst_anchor_spread_s={worst:.4f}")
+    finally:
+        if watchdog is not None:
+            watchdog.stop()
+        net.stop()
+
+    if failures:
+        return _fail(failures)
+    print("[flight-smoke] OK")
+    return 0
+
+
+def _fail(failures) -> int:
+    for f in failures:
+        print(f"[flight-smoke] FAIL: {f}", file=sys.stderr)
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

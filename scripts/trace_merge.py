@@ -1,0 +1,248 @@
+"""Cross-node flight/trace merger (`make flight-smoke`, operator runbook).
+
+Fetches `dump_flight` (per-height consensus lifecycle records) and optionally
+`dump_trace` (span-tracer rings) from a comma-separated endpoint list and
+fuses them into ONE Chrome trace-event JSON — one track (pid) per node — for
+chrome://tracing or ui.perfetto.dev.
+
+Clock alignment: every flight record carries wall-clock timestamps, but node
+wall clocks disagree (NTP skew).  A commit of height H with hash X is the
+same *instant class* on every node that committed it, so shared (height,
+commit-hash) anchors give per-node offsets: each node's skew is the median of
+(reference_commit_t - node_commit_t) over shared anchors, with the first
+endpoint as reference.  Span-tracer events are perf_counter-based
+(process-local); `dump_trace` ships a {wall_ns, perf_ns} anchor pair taken at
+dump time, which places them on the same wall timeline before the same skew
+correction is applied.
+
+Usage:
+    python scripts/trace_merge.py --endpoints tcp://h1:26657,tcp://h2:26657 \
+        [--limit 256] [--with-trace] [-o merged_trace.json]
+
+The core (`compute_skews` / `merge` / `anchor_spread`) is importable — the
+flight smoke and tests drive it with in-process dumps, no RPC needed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import statistics
+import sys
+from typing import Dict, List, Optional, Tuple
+
+_FLIGHT_TID = 0  # every flight-recorder track uses tid 0 ("consensus")
+
+
+def _commit_anchors(dump: dict) -> Dict[Tuple[int, str], int]:
+    """(height, commit_hash) -> commit wall time ns for one node's dump."""
+    out = {}
+    for rec in dump.get("records", []):
+        c = rec.get("commit")
+        if c and c.get("hash"):
+            out[(rec["height"], c["hash"])] = c["t"]
+    return out
+
+
+def compute_skews(dumps: List[dict]) -> List[int]:
+    """Per-node clock skew in ns relative to dumps[0]: ADD skews[i] to node
+    i's wall timestamps to land on the reference timeline.  Nodes sharing no
+    commit anchor with the reference get skew 0 (flagged by the caller)."""
+    if not dumps:
+        return []
+    ref = _commit_anchors(dumps[0])
+    skews = [0]
+    for dump in dumps[1:]:
+        own = _commit_anchors(dump)
+        deltas = [ref[a] - own[a] for a in own.keys() & ref.keys()]
+        skews.append(int(statistics.median(deltas)) if deltas else 0)
+    return skews
+
+
+def anchor_spread(dumps: List[dict], skews: List[int]) -> Dict[int, float]:
+    """Per-height max disagreement (seconds) of skew-corrected commit times
+    across nodes — the residual alignment error.  Only heights committed by
+    >= 2 nodes with the same hash appear."""
+    by_anchor: Dict[Tuple[int, str], List[int]] = {}
+    for dump, skew in zip(dumps, skews):
+        for anchor, t in _commit_anchors(dump).items():
+            by_anchor.setdefault(anchor, []).append(t + skew)
+    return {
+        h: (max(ts) - min(ts)) / 1e9
+        for (h, _hash), ts in by_anchor.items()
+        if len(ts) >= 2
+    }
+
+
+def _us(t_ns: int, skew_ns: int) -> float:
+    return (t_ns + skew_ns) / 1000.0
+
+
+def _flight_events(dump: dict, pid: int, skew_ns: int) -> List[dict]:
+    node = dump.get("node_id") or f"node{pid}"
+    events: List[dict] = [
+        {"name": "process_name", "ph": "M", "pid": pid, "tid": _FLIGHT_TID,
+         "args": {"name": node}},
+        {"name": "thread_name", "ph": "M", "pid": pid, "tid": _FLIGHT_TID,
+         "args": {"name": "consensus"}},
+    ]
+
+    def instant(name: str, t_ns: int, **args) -> None:
+        ev = {"name": name, "cat": "flight", "ph": "i", "s": "t",
+              "pid": pid, "tid": _FLIGHT_TID, "ts": _us(t_ns, skew_ns)}
+        if args:
+            ev["args"] = args
+        events.append(ev)
+
+    for rec in dump.get("records", []):
+        h = rec["height"]
+        stamps = []
+        for r in rec.get("rounds", []):
+            instant("new_round", r["t"], height=h, round=r["round"])
+            stamps.append(r["t"])
+        p = rec.get("proposal")
+        if p:
+            instant("proposal", p["t"], height=h, round=p["round"],
+                    peer=p["peer"])
+            stamps.append(p["t"])
+        bp = rec.get("block_parts")
+        if bp:
+            instant("block_parts_complete", bp["t"], height=h)
+            stamps.append(bp["t"])
+        for kind in ("prevote", "precommit"):
+            vs = rec.get(kind) or {}
+            for which in ("first", "last"):
+                mark = vs.get(which)
+                if mark and (which == "first" or vs.get("count", 0) > 1):
+                    instant(f"{kind}_{which}", mark["t"], height=h,
+                            round=mark["round"], peer=mark["peer"],
+                            validator_index=mark["validator_index"])
+                    stamps.append(mark["t"])
+        pol = rec.get("polka")
+        if pol:
+            instant("polka", pol["t"], height=h, round=pol["round"])
+            stamps.append(pol["t"])
+        c = rec.get("commit")
+        if c:
+            instant("commit", c["t"], height=h, round=c["round"],
+                    hash=c["hash"])
+            stamps.append(c["t"])
+        ex = rec.get("exec")
+        if ex:
+            events.append({
+                "name": "abci_execute", "cat": "flight", "ph": "X",
+                "pid": pid, "tid": _FLIGHT_TID,
+                "ts": _us(ex["t"], skew_ns),
+                "dur": max(ex["dur_ns"], 0) / 1000.0,
+                "args": {"height": h},
+            })
+            stamps.extend([ex["t"], ex["t"] + max(ex["dur_ns"], 0)])
+        if stamps:
+            t0, t1 = min(stamps), max(stamps)
+            events.append({
+                "name": f"height {h}", "cat": "flight", "ph": "X",
+                "pid": pid, "tid": _FLIGHT_TID,
+                "ts": _us(t0, skew_ns), "dur": (t1 - t0) / 1000.0,
+                "args": {
+                    "height": h,
+                    "rounds": len(rec.get("rounds", [])),
+                    "prevotes": (rec.get("prevote") or {}).get("count", 0),
+                    "precommits": (rec.get("precommit") or {}).get("count", 0),
+                },
+            })
+    return events
+
+
+def _trace_events(payload: dict, pid: int, skew_ns: int) -> List[dict]:
+    """Retag one node's dump_trace events onto its merged track.  Trace ts
+    are perf_counter µs; the dump-time {wall_ns, perf_ns} anchor converts
+    them to wall µs before the cross-node skew correction."""
+    anchor = payload.get("anchor") or {}
+    if "wall_ns" not in anchor or "perf_ns" not in anchor:
+        return []
+    wall_offset_us = (anchor["wall_ns"] - anchor["perf_ns"] + skew_ns) / 1000.0
+    out = []
+    for ev in payload.get("traceEvents", []):
+        ev = dict(ev)
+        ev["pid"] = pid
+        if ev.get("ph") != "M":
+            ev["ts"] = ev.get("ts", 0.0) + wall_offset_us
+        out.append(ev)
+    return out
+
+
+def merge(dumps: List[dict], traces: Optional[List[Optional[dict]]] = None,
+          skews: Optional[List[int]] = None) -> dict:
+    """Fuse per-node dump_flight payloads (and optional index-aligned
+    dump_trace payloads) into one Chrome trace-event dict."""
+    skews = compute_skews(dumps) if skews is None else skews
+    events: List[dict] = []
+    for pid, (dump, skew) in enumerate(zip(dumps, skews)):
+        events.extend(_flight_events(dump, pid, skew))
+        if traces is not None and pid < len(traces) and traces[pid]:
+            events.extend(_trace_events(traces[pid], pid, skew))
+    return {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "nodes": [d.get("node_id") or f"node{i}"
+                      for i, d in enumerate(dumps)],
+            "skews_ns": list(skews),
+        },
+    }
+
+
+# --- CLI -------------------------------------------------------------------
+
+
+def _fetch(endpoints: List[str], limit: Optional[int], with_trace: bool):
+    from tendermint_tpu.rpc.client import HTTPClient
+
+    dumps, traces = [], []
+    for ep in endpoints:
+        c = HTTPClient(ep)
+        dumps.append(c.dump_flight(limit))
+        traces.append(c.dump_trace(limit) if with_trace else None)
+    return dumps, traces
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n", 1)[0])
+    ap.add_argument(
+        "--endpoints", required=True,
+        help="comma-separated RPC endpoints (tcp://host:port,...)",
+    )
+    ap.add_argument("--limit", type=int, default=None,
+                    help="newest N records/events per node")
+    ap.add_argument("--with-trace", action="store_true",
+                    help="also fetch+merge each node's dump_trace ring")
+    ap.add_argument("-o", "--output", default="merged_trace.json")
+    args = ap.parse_args(argv)
+
+    endpoints = [e.strip() for e in args.endpoints.split(",") if e.strip()]
+    if not endpoints:
+        print("no endpoints", file=sys.stderr)
+        return 2
+    dumps, traces = _fetch(endpoints, args.limit, args.with_trace)
+    skews = compute_skews(dumps)
+    merged = merge(dumps, traces, skews=skews)
+    with open(args.output, "w") as f:
+        json.dump(merged, f)
+    spread = anchor_spread(dumps, skews)
+    worst = max(spread.values()) if spread else None
+    print(
+        f"merged {len(dumps)} nodes, {len(merged['traceEvents'])} events "
+        f"-> {args.output}"
+    )
+    print(f"skews_ns={skews} shared_heights={len(spread)} "
+          f"worst_anchor_spread_s={worst}")
+    return 0
+
+
+if __name__ == "__main__":
+    import os
+
+    sys.path.insert(
+        0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    sys.exit(main())

@@ -170,6 +170,15 @@ class InstrumentationConfig:
     prometheus_listen_addr: str = ":26660"
     max_open_connections: int = 3
     namespace: str = "tendermint"
+    # consensus flight recorder (consensus/flight.py); TM_FLIGHT=1 also works
+    flight_recorder: bool = False
+    # liveness watchdog (libs/watchdog.py): stall when no height/round
+    # progress for stall_factor × block-interval EWMA (floored at
+    # watchdog_min_stall_seconds)
+    watchdog: bool = True
+    watchdog_interval: float = 1.0
+    watchdog_stall_factor: float = 5.0
+    watchdog_min_stall_seconds: float = 10.0
 
 
 @dataclass

@@ -1,0 +1,229 @@
+"""Consensus flight recorder — a fixed-size ring of per-height lifecycle
+records.
+
+Where libs/trace.py answers "what did THIS thread spend time on", the flight
+recorder answers the liveness question operators actually ask: for height H,
+when did each node enter the round, first see the proposal, complete the
+block parts, collect its first/last prevote and precommit (and from which
+peer), form the polka, commit, and execute the block through ABCI.
+
+Timestamps are WALL-clock nanoseconds (`time.time_ns`), not perf_counter:
+records from different nodes must be fusable on one timeline.  Each record
+is tagged with the recorder's `node_id`; `scripts/trace_merge.py` aligns
+per-node clocks using commit events of shared heights as anchors (same
+commit hash = same instant class) and emits a merged Chrome trace with one
+track per node.
+
+Disabled (the default) every hook is one attribute check and an early
+return — the same <1% gate `libs/trace.py` holds on the host fast-sync
+bench.  Enable with TM_FLIGHT=1, `[instrumentation] flight_recorder`, the
+`flight_reset` RPC, or `FlightRecorder.enable()`.
+
+Unlike the tracer this is NOT a process singleton: each ConsensusState owns
+one recorder (``cs.flight``), so in-proc multi-node tests and smokes get
+genuinely per-node records.
+"""
+
+from __future__ import annotations
+
+import copy as _copy
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+_now_ns = time.time_ns  # wall clock: cross-node fusable (see module doc)
+
+DEFAULT_CAPACITY = 512  # heights remembered before the ring evicts
+MAX_PEERS_PER_RECORD = 64  # per-peer vote attribution cap ("overflow" folds)
+
+
+def _vote_slot() -> dict:
+    return {"first": None, "last": None, "count": 0, "by_peer": {}}
+
+
+class FlightRecorder:
+    """Ring of per-height records.  One per ConsensusState; every mutation
+    takes the recorder lock (hooks run on the consensus receive thread and
+    the reactor's peer threads)."""
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY, node_id: str = "",
+                 enabled: bool = False):
+        self._mtx = threading.Lock()
+        self.enabled = enabled
+        self.node_id = node_id
+        self._configure(capacity)
+
+    @classmethod
+    def from_env(cls) -> "FlightRecorder":
+        cap = int(os.environ.get("TM_FLIGHT_BUFFER", "") or DEFAULT_CAPACITY)
+        on = os.environ.get("TM_FLIGHT", "") not in ("", "0")
+        return cls(cap, enabled=on)
+
+    def _configure(self, capacity: int) -> None:
+        if capacity < 1:
+            raise ValueError(f"flight capacity must be >= 1, got {capacity}")
+        self.capacity = capacity
+        self._buf: List[Optional[dict]] = [None] * capacity
+        self._by_height: Dict[int, int] = {}  # height -> ring slot
+        self._next = 0  # records ever allocated; slot = _next % capacity
+        self._evicted = 0
+
+    # control ---------------------------------------------------------------
+    def enable(self, capacity: Optional[int] = None) -> None:
+        with self._mtx:
+            if capacity is not None and capacity != self.capacity:
+                self._configure(capacity)
+            self.enabled = True
+
+    def disable(self) -> None:
+        with self._mtx:
+            self.enabled = False
+
+    def reset(self, capacity: Optional[int] = None) -> None:
+        with self._mtx:
+            self._configure(capacity if capacity is not None else self.capacity)
+
+    def evicted(self) -> int:
+        """Height records overwritten by ring wraparound since last reset."""
+        with self._mtx:
+            return self._evicted
+
+    def __len__(self) -> int:
+        with self._mtx:
+            return min(self._next, self.capacity)
+
+    # record access (callers hold self._mtx) --------------------------------
+    def _rec(self, height: int) -> dict:
+        slot = self._by_height.get(height)
+        if slot is not None:
+            return self._buf[slot]
+        slot = self._next % self.capacity
+        old = self._buf[slot]
+        if old is not None:
+            self._by_height.pop(old["height"], None)
+            self._evicted += 1
+        rec = {
+            "height": height,
+            "rounds": [],       # [{round, t}]
+            "proposal": None,   # {t, round, peer}
+            "block_parts": None,  # {t}
+            "prevote": _vote_slot(),
+            "precommit": _vote_slot(),
+            "polka": None,      # {t, round}
+            "commit": None,     # {t, round, hash}
+            "exec": None,       # {t, dur_ns}
+        }
+        self._buf[slot] = rec
+        self._by_height[height] = slot
+        self._next += 1
+        return rec
+
+    # milestone hooks -------------------------------------------------------
+    def on_new_round(self, height: int, round: int) -> None:
+        if not self.enabled:
+            return
+        t = _now_ns()
+        with self._mtx:
+            self._rec(height)["rounds"].append({"round": round, "t": t})
+
+    def on_proposal(self, height: int, round: int, peer_id: str = "") -> None:
+        """First sighting of the height's proposal.  The reactor calls this
+        from its receive path with the gossiping peer's id; the state machine
+        calls it with "" when it accepts (covers our own proposals).  First
+        call wins — it IS the first-seen time."""
+        if not self.enabled:
+            return
+        t = _now_ns()
+        with self._mtx:
+            rec = self._rec(height)
+            if rec["proposal"] is None:
+                rec["proposal"] = {
+                    "t": t, "round": round, "peer": peer_id or "local"
+                }
+
+    def on_block_parts_complete(self, height: int) -> None:
+        if not self.enabled:
+            return
+        t = _now_ns()
+        with self._mtx:
+            rec = self._rec(height)
+            if rec["block_parts"] is None:
+                rec["block_parts"] = {"t": t}
+
+    def on_vote(self, height: int, round: int, kind: str, peer_id: str,
+                validator_index: int) -> None:
+        """One vote ADDED by the state machine (post-dedup/verify).  kind is
+        "prevote" | "precommit"; peer_id "" means our own/internal vote."""
+        if not self.enabled:
+            return
+        t = _now_ns()
+        peer = peer_id or "local"
+        with self._mtx:
+            slot = self._rec(height)[kind]
+            mark = {"t": t, "round": round, "peer": peer,
+                    "validator_index": validator_index}
+            if slot["first"] is None:
+                slot["first"] = mark
+            slot["last"] = mark
+            slot["count"] += 1
+            by_peer = slot["by_peer"]
+            if peer not in by_peer and len(by_peer) >= MAX_PEERS_PER_RECORD:
+                peer = "overflow"
+            by_peer[peer] = by_peer.get(peer, 0) + 1
+
+    def on_polka(self, height: int, round: int) -> None:
+        if not self.enabled:
+            return
+        t = _now_ns()
+        with self._mtx:
+            rec = self._rec(height)
+            if rec["polka"] is None:
+                rec["polka"] = {"t": t, "round": round}
+
+    def on_commit(self, height: int, round: int, block_hash: bytes = b"") -> None:
+        if not self.enabled:
+            return
+        t = _now_ns()
+        with self._mtx:
+            rec = self._rec(height)
+            if rec["commit"] is None:
+                rec["commit"] = {
+                    "t": t, "round": round,
+                    "hash": (block_hash or b"").hex().upper(),
+                }
+
+    def on_execute(self, height: int, t0_ns: int, t1_ns: int) -> None:
+        """The ABCI apply_block span for the committed height."""
+        if not self.enabled:
+            return
+        with self._mtx:
+            self._rec(height)["exec"] = {"t": t0_ns, "dur_ns": t1_ns - t0_ns}
+
+    # export ----------------------------------------------------------------
+    def records(self, limit: Optional[int] = None) -> List[dict]:
+        """Deep-copied records, oldest first (newest N when limit is set)."""
+        with self._mtx:
+            heights = sorted(self._by_height)
+            if limit is not None and limit >= 0:
+                heights = heights[-limit:] if limit else []
+            out = [
+                _copy.deepcopy(self._buf[self._by_height[h]]) for h in heights
+            ]
+        return out
+
+    def snapshot(self, limit: Optional[int] = None) -> dict:
+        """The dump_flight RPC payload: records plus the metadata the
+        cross-node merger needs."""
+        with self._mtx:
+            total = len(self._by_height)
+        recs = self.records(limit)
+        return {
+            "node_id": self.node_id,
+            "enabled": self.enabled,
+            "capacity": self.capacity,
+            "evicted": self.evicted(),
+            "total_records": total,
+            "truncated": len(recs) < total,
+            "records": recs,
+        }

@@ -411,6 +411,11 @@ class ConsensusReactor(Reactor):
                 return
             if isinstance(msg, ProposalMessage):
                 ps.set_has_proposal(msg.proposal)
+                # first-seen stamp happens HERE (receive path), not when the
+                # state machine accepts — gossip latency is what we're after
+                self.cons.flight.on_proposal(
+                    msg.proposal.height, msg.proposal.round, peer.id
+                )
                 self.cons.send_peer_msg(msg, peer.id)
             elif isinstance(msg, ProposalPOLMessage):
                 ps.apply_proposal_pol(msg)

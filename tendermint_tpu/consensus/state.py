@@ -33,6 +33,7 @@ from tendermint_tpu.consensus.messages import (
     TimeoutInfo,
     VoteMessage,
 )
+from tendermint_tpu.consensus.flight import FlightRecorder
 from tendermint_tpu.consensus.ticker import TimeoutTicker
 from tendermint_tpu.consensus.wal import NilWAL, WAL
 from tendermint_tpu.libs import trace
@@ -101,6 +102,9 @@ class ConsensusState(BaseService):
         self.mempool = mempool
         self.evpool = evpool
         self.metrics = metrics
+        # per-height lifecycle ledger; disabled unless TM_FLIGHT /
+        # [instrumentation] flight_recorder / flight_reset turns it on
+        self.flight = FlightRecorder.from_env()
         # step-duration accounting: each _new_step observes the wall time
         # spent in the step being LEFT (None until the first transition)
         self._step_started: Optional[float] = None
@@ -445,6 +449,7 @@ class ConsensusState(BaseService):
         ):
             return
         self.logger.info("enterNewRound(%d/%d)", height, round)
+        self.flight.on_new_round(height, round)
 
         validators = rs.validators
         if rs.round < round:
@@ -646,6 +651,7 @@ class ConsensusState(BaseService):
                 return
 
             self._publish_rs_event(EVENT_POLKA)
+            self.flight.on_polka(height, round)
             pol_round, _ = rs.votes.pol_info()
             if pol_round < round:
                 raise ConsensusError(f"POLRound should be {round} but got {pol_round}")
@@ -723,6 +729,7 @@ class ConsensusState(BaseService):
             block_id = rs.votes.precommits(commit_round).two_thirds_majority()
             if block_id is None:
                 raise ConsensusError("enterCommit expects +2/3 precommits")
+            self.flight.on_commit(height, commit_round, block_id.hash)
             if rs.locked_block is not None and rs.locked_block.hashes_to(block_id.hash):
                 rs.proposal_block = rs.locked_block
                 rs.proposal_block_parts = rs.locked_block_parts
@@ -795,6 +802,7 @@ class ConsensusState(BaseService):
         fail.fail_point()
 
         state_copy = self.state.copy()
+        exec_t0 = time.time_ns()
         try:
             state_copy = self.block_exec.apply_block(
                 state_copy, BlockID(hash=block.hash(), parts_header=block_parts.header()),
@@ -803,6 +811,7 @@ class ConsensusState(BaseService):
         except Exception as e:
             self.logger.error("error on ApplyBlock: %s — halting", e)
             raise
+        self.flight.on_execute(height, exec_t0, time.time_ns())
 
         fail.fail_point()
 
@@ -831,6 +840,7 @@ class ConsensusState(BaseService):
         rs.proposal = proposal
         if rs.proposal_block_parts is None:
             rs.proposal_block_parts = PartSet(proposal.block_id.parts_header)
+        self.flight.on_proposal(rs.height, rs.round)
         self.logger.info("received proposal %s", proposal)
 
     def _add_proposal_block_part(self, msg: BlockPartMessage, peer_id: str) -> bool:
@@ -846,6 +856,7 @@ class ConsensusState(BaseService):
             if len(data) > self.state.consensus_params.block_size.max_bytes:
                 raise ConsensusError("proposal block too big")
             rs.proposal_block = Block.unmarshal(data)
+            self.flight.on_block_parts_complete(height)
             self.logger.info(
                 "received complete proposal block h=%d %s",
                 rs.proposal_block.height, rs.proposal_block,
@@ -933,6 +944,9 @@ class ConsensusState(BaseService):
             if not added:
                 return False
             self._observe_vote_latency(vote)
+            self.flight.on_vote(
+                vote.height, vote.round, "precommit", peer_id, vote.validator_index
+            )
             self._publish_vote_event(vote)
             if self.config.skip_timeout_commit and rs.last_commit.has_all():
                 self.enter_new_round(rs.height, 0)
@@ -946,6 +960,13 @@ class ConsensusState(BaseService):
         if not added:
             return False
         self._observe_vote_latency(vote)
+        self.flight.on_vote(
+            vote.height,
+            vote.round,
+            "prevote" if vote.vote_type == SignedMsgType.PREVOTE else "precommit",
+            peer_id,
+            vote.validator_index,
+        )
         self._publish_vote_event(vote)
 
         if vote.vote_type == SignedMsgType.PREVOTE:

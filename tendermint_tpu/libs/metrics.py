@@ -513,6 +513,21 @@ class NodeMetrics:
             "consensus_wal_fsync_seconds", "WAL fsync wall seconds",
             buckets=[b / 10 for b in _DEFAULT_BUCKETS],
         )
+        # liveness watchdog (libs/watchdog.py)
+        self.stalls = r.counter(
+            "consensus_stalls_total",
+            "Distinct consensus stalls detected by the liveness watchdog",
+        )
+        self.stall_seconds = r.gauge(
+            "consensus_stall_seconds",
+            "Age of the current consensus stall (0 when progressing)",
+        )
+        # pubsub (libs/pubsub.py slow-subscriber drops)
+        self.pubsub_dropped = r.counter(
+            "pubsub_dropped_events_total",
+            "Events dropped because a subscriber's buffer was full",
+            label_names=("client_id",),
+        )
         # p2p
         self.peers = r.gauge("p2p_peers", "Connected peers")
         self.peer_receive_bytes = r.counter(

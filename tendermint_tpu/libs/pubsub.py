@@ -12,11 +12,12 @@ whose query matches the tags, each subscriber getting its own queue.
 
 from __future__ import annotations
 
+import logging
 import queue
 import re
 import threading
 from dataclasses import dataclass
-from typing import Any, Dict, List, Optional, Tuple, Union
+from typing import Any, Callable, Dict, List, Optional, Tuple, Union
 
 # ---------------------------------------------------------------------------
 # Query language
@@ -172,10 +173,45 @@ class Subscription:
 class Server:
     """clientID × query → Subscription (ref pubsub.go Server)."""
 
-    def __init__(self, buffer: int = 0):
+    def __init__(self, buffer: int = 0,
+                 on_drop: Optional[Callable[[str], None]] = None):
         self._mtx = threading.RLock()
         self._subs: Dict[str, Dict[Query, Subscription]] = {}
         self._buffer = buffer
+        # slow-subscriber drop accounting: per-client counts, a warning on
+        # the FIRST drop per client (silent shedding hides real bugs), and
+        # an optional callback (node.py feeds the
+        # tendermint_pubsub_dropped_events_total{client_id} counter)
+        self._dropped: Dict[str, int] = {}
+        self._on_drop = on_drop
+        self._logger = logging.getLogger("pubsub")
+
+    def set_on_drop(self, fn: Optional[Callable[[str], None]]) -> None:
+        with self._mtx:
+            self._on_drop = fn
+
+    def dropped_events(self, client_id: Optional[str] = None):
+        """Total drops for one client, or a {client_id: count} copy."""
+        with self._mtx:
+            if client_id is not None:
+                return self._dropped.get(client_id, 0)
+            return dict(self._dropped)
+
+    def _note_drop(self, client_id: str) -> None:
+        with self._mtx:
+            n = self._dropped.get(client_id, 0) + 1
+            self._dropped[client_id] = n
+            on_drop = self._on_drop
+        if n == 1:
+            self._logger.warning(
+                "dropping events for slow subscriber %r (buffer full); "
+                "further drops counted silently", client_id
+            )
+        if on_drop is not None:
+            try:
+                on_drop(client_id)
+            except Exception:
+                self._logger.exception("pubsub on_drop callback failed")
 
     def subscribe(self, client_id: str, q: Union[str, Query], maxsize: int = 0) -> Subscription:
         q = Query(q) if isinstance(q, str) else q
@@ -209,17 +245,19 @@ class Server:
         tags = tags or {}
         with self._mtx:
             targets = [
-                sub
-                for by_client in self._subs.values()
+                (client_id, sub)
+                for client_id, by_client in self._subs.items()
                 for q, sub in by_client.items()
                 if q.matches(tags)
             ]
         msg = Message(data=data, tags=tags)
-        for sub in targets:
+        for client_id, sub in targets:
             try:
                 sub.queue.put_nowait(msg)
             except queue.Full:
-                pass  # slow subscriber: drop (reference blocks; we shed load)
+                # slow subscriber: drop (reference blocks; we shed load) —
+                # but never silently
+                self._note_drop(client_id)
 
     def num_clients(self) -> int:
         with self._mtx:

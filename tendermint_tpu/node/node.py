@@ -160,6 +160,12 @@ class Node(BaseService):
         from tendermint_tpu.libs.metrics import NodeMetrics
 
         self.metrics = NodeMetrics() if config.instrumentation.prometheus else None
+        if self.metrics is not None:
+            # slow-subscriber drop accounting (libs/pubsub.py)
+            m = self.metrics
+            self.event_bus.set_on_drop(
+                lambda client_id: m.pubsub_dropped.add(1.0, (client_id,))
+            )
 
         # mempool + evidence (optional mempool WAL, mempool.go:223 InitWAL)
         mempool_wal = None
@@ -208,6 +214,12 @@ class Node(BaseService):
         self.consensus_state.set_event_bus(self.event_bus)
         if priv_validator is not None:
             self.consensus_state.set_priv_validator(priv_validator)
+        # flight recorder identity + config gate (env TM_FLIGHT may have
+        # enabled it already; _build_p2p upgrades node_id to the p2p id)
+        self.consensus_state.flight.node_id = config.base.moniker
+        if config.instrumentation.flight_recorder:
+            self.consensus_state.flight.enable()
+        self.watchdog = None
 
         # p2p: transport + switch + reactors (node.go:372-471). Disabled
         # (single-node) when p2p.laddr is empty — node.go:246-252's
@@ -240,6 +252,7 @@ class Node(BaseService):
         )
 
         self.node_key = NodeKey.load_or_generate(config.base.node_key_path())
+        self.consensus_state.flight.node_id = self.node_key.id()
         fast_sync = config.base.fast_sync
         # Never fast-sync when the only validator is us (node.go:246-252):
         # there is no one to sync from, and waiting for peers stalls a
@@ -497,6 +510,20 @@ class Node(BaseService):
                 ).start()
         else:
             self.consensus_state.start()
+        if self.config.instrumentation.watchdog:
+            from tendermint_tpu.libs.watchdog import LivenessWatchdog
+
+            inst = self.config.instrumentation
+            self.watchdog = LivenessWatchdog(
+                self.consensus_state,
+                switch=self.switch,
+                metrics=self.metrics,
+                interval=inst.watchdog_interval,
+                stall_factor=inst.watchdog_stall_factor,
+                min_stall_seconds=inst.watchdog_min_stall_seconds,
+                logger=self.logger,
+            )
+            self.watchdog.start()
         self.logger.info("node started chain_id=%s", self.genesis_doc.chain_id)
 
     def _p2p_metrics_pump(self) -> None:
@@ -519,7 +546,8 @@ class Node(BaseService):
 
     def on_stop(self) -> None:
         # switch first: it stops its reactors, which stop the consensus state
-        services = [self.switch] if self.switch is not None else [self.consensus_state]
+        services = [self.watchdog]
+        services += [self.switch] if self.switch is not None else [self.consensus_state]
         services += [self.rpc_server, self.grpc_broadcast, self.indexer_service,
                      self.event_bus, self.proxy_app, self.signer_endpoint]
         for svc in services:

@@ -174,6 +174,9 @@ class MConnection(BaseService):
         self._err_once = threading.Lock()
         self._errored = False
         self._threads: List[threading.Thread] = []
+        # monotonic stamp of the last byte read off the wire (pings count):
+        # the liveness watchdog reports per-peer last-receive ages from this
+        self._last_recv_at = time.monotonic()
 
     # -- lifecycle -----------------------------------------------------------
     def on_start(self) -> None:
@@ -247,6 +250,7 @@ class MConnection(BaseService):
         return {
             "send_rate": self._send_monitor.status().inst_rate,
             "recv_rate": self._recv_monitor.status().inst_rate,
+            "last_recv_age": round(time.monotonic() - self._last_recv_at, 3),
             "channels": {
                 f"{cid:#x}": {
                     "send_queue": ch.send_queue.qsize(),
@@ -366,6 +370,7 @@ class MConnection(BaseService):
                 )
                 pkt_type = self._conn.read_exactly(1)[0]
                 self._recv_monitor.update(1)
+                self._last_recv_at = time.monotonic()
                 if pkt_type == _PKT_PING:
                     self._pong_pending.set()
                     self._send_signal.set()

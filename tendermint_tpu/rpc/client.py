@@ -117,6 +117,38 @@ class HTTPClient:
     def unsafe_flush_mempool(self) -> dict:
         return self.call("unsafe_flush_mempool")
 
+    # -- debug dumps (unsafe-gated server side) -----------------------------
+    def dump_trace(self, limit: Optional[int] = None) -> dict:
+        return self.call(
+            "dump_trace", **({"limit": limit} if limit is not None else {})
+        )
+
+    def trace_reset(self, enable=None, capacity: Optional[int] = None) -> dict:
+        params = {}
+        if enable is not None:
+            params["enable"] = enable
+        if capacity is not None:
+            params["capacity"] = capacity
+        return self.call("trace_reset", **params)
+
+    def dump_profile(self, limit: Optional[int] = None) -> dict:
+        return self.call(
+            "dump_profile", **({"limit": limit} if limit is not None else {})
+        )
+
+    def dump_flight(self, limit: Optional[int] = None) -> dict:
+        return self.call(
+            "dump_flight", **({"limit": limit} if limit is not None else {})
+        )
+
+    def flight_reset(self, enable=None, capacity: Optional[int] = None) -> dict:
+        params = {}
+        if enable is not None:
+            params["enable"] = enable
+        if capacity is not None:
+            params["capacity"] = capacity
+        return self.call("flight_reset", **params)
+
     def unconfirmed_txs(self, limit: int = 30) -> dict:
         return self.call("unconfirmed_txs", limit=limit)
 

@@ -36,7 +36,13 @@ class RPCEnv:
 
     # info ------------------------------------------------------------------
     def health(self) -> dict:
-        return {}
+        """Empty when healthy and no watchdog; with the liveness watchdog
+        running it carries the compact stall summary so `curl /health` is
+        enough to see a stuck chain."""
+        wd = getattr(self.node, "watchdog", None)
+        if wd is None:
+            return {}
+        return wd.status()
 
     def status(self) -> dict:
         return self.node.status()
@@ -271,7 +277,7 @@ class RPCEnv:
 
     def dump_consensus_state(self) -> dict:
         rs = self.node.consensus_state.get_round_state()
-        return {
+        out = {
             "round_state": {
                 "height": rs.height,
                 "round": rs.round,
@@ -281,6 +287,10 @@ class RPCEnv:
                 "proposal": str(rs.proposal) if rs.proposal else None,
             }
         }
+        wd = getattr(self.node, "watchdog", None)
+        if wd is not None:
+            out["stall"] = wd.report() or wd.status()
+        return out
 
     def statesync(self) -> dict:
         """Snapshot restore / serving progress (chunks applied, backfill
@@ -488,16 +498,43 @@ class RPCEnv:
         self.node.mempool.flush()
         return {}
 
-    def dump_trace(self) -> dict:
+    def dump_trace(self, limit=None) -> dict:
         """Snapshot the span-tracer ring as Chrome trace-event JSON (load at
         chrome://tracing or ui.perfetto.dev).  Gated like the unsafe_*
-        routes — the dump leaks internal timings and thread names."""
+        routes — the dump leaks internal timings and thread names.
+
+        limit=N keeps only the newest N events (thread-name "M" metadata is
+        always kept) so a full 8192-span ring can't blow up a WS frame.  The
+        `anchor` pairs a wall-clock and a perf-counter reading taken at dump
+        time: trace timestamps are perf_counter-based (process-local), and
+        trace_merge.py needs the pair to place them on a wall timeline."""
         self._require_unsafe()
+        import time as _time
+
         from tendermint_tpu.libs import trace
 
         out = trace.chrome_trace()
+        events = out.get("traceEvents", [])
+        meta = [e for e in events if e.get("ph") == "M"]
+        spans = [e for e in events if e.get("ph") != "M"]
+        total = len(spans)
+        truncated = False
+        if limit is not None:
+            limit = int(limit)
+            if limit < 0:
+                raise RPCError(-32602, "limit must be >= 0")
+            if total > limit:
+                spans = spans[total - limit:]  # export is oldest-first
+                truncated = True
+        out["traceEvents"] = meta + spans
         out["enabled"] = trace.enabled()
         out["dropped"] = trace.dropped()
+        out["total_events"] = total
+        out["truncated"] = truncated
+        out["anchor"] = {
+            "wall_ns": _time.time_ns(),
+            "perf_ns": _time.perf_counter_ns(),
+        }
         return out
 
     def trace_reset(self, enable=None, capacity=None) -> dict:
@@ -521,20 +558,65 @@ class RPCEnv:
             "capacity": trace.get_tracer().capacity,
         }
 
-    def dump_profile(self) -> dict:
+    def dump_profile(self, limit=None) -> dict:
         """Snapshot the device-dispatch cost ledger: per-window rows of
         host pack / compile / device run seconds, bytes shipped, and lane
         occupancy (libs/profile.py).  Gated like dump_trace — the ledger
-        leaks internal timings."""
+        leaks internal timings.  limit=N keeps the newest N entries (the
+        aggregate ledger always covers the full ring)."""
         self._require_unsafe()
         from tendermint_tpu.libs.profile import get_profiler
 
         p = get_profiler()
+        entries = p.entries()
+        total = len(entries)
+        truncated = False
+        if limit is not None:
+            limit = int(limit)
+            if limit < 0:
+                raise RPCError(-32602, "limit must be >= 0")
+            if total > limit:
+                entries = entries[total - limit:]  # oldest-first
+                truncated = True
         return {
             "ledger": p.ledger(),
-            "entries": p.entries(),
+            "entries": entries,
+            "total_entries": total,
+            "truncated": truncated,
             "dropped": p.dropped,
         }
+
+    def dump_flight(self, limit=None) -> dict:
+        """Snapshot the consensus flight recorder: per-height lifecycle
+        records (consensus/flight.py) plus the current watchdog stall
+        report.  limit=N keeps the newest N height records.  Gated like
+        dump_trace — per-peer vote attribution leaks topology."""
+        self._require_unsafe()
+        if limit is not None:
+            limit = int(limit)
+            if limit < 0:
+                raise RPCError(-32602, "limit must be >= 0")
+        out = self.node.consensus_state.flight.snapshot(limit)
+        wd = getattr(self.node, "watchdog", None)
+        out["stall"] = wd.report() if wd is not None else None
+        return out
+
+    def flight_reset(self, enable=None, capacity=None) -> dict:
+        """Clear the flight-recorder ring; optionally flip it on/off
+        (enable=true/false) and resize the ring (capacity=N)."""
+        self._require_unsafe()
+        flight = self.node.consensus_state.flight
+        if capacity is not None:
+            capacity = int(capacity)
+            if capacity < 1:
+                raise RPCError(-32602, "capacity must be >= 1")
+        flight.reset(capacity)
+        if enable is not None:
+            if bool(enable):
+                flight.enable()
+            else:
+                flight.disable()
+        return {"enabled": flight.enabled, "capacity": flight.capacity}
 
     def profile_reset(self, capacity=None) -> dict:
         """Clear the dispatch-cost ledger; optionally resize the ring

@@ -77,6 +77,9 @@ class NodeMonitor:
         # hot-path columns from /metrics
         self.verify_ms = 0.0  # avg verify-dispatch latency
         self.traffic_bytes = 0.0  # total per-peer send+recv wire bytes
+        # liveness-watchdog columns (tendermint_consensus_stall*)
+        self.stalls_total = 0
+        self.stall_seconds = 0.0
         self._last_block_at: Optional[float] = None
         self._started = time.monotonic()
         self._online_time = 0.0
@@ -127,6 +130,12 @@ class NodeMonitor:
         self.traffic_bytes = _sum_family(
             m, "tendermint_p2p_peer_send_bytes_total"
         ) + _sum_family(m, "tendermint_p2p_peer_receive_bytes_total")
+        self.stalls_total = int(
+            _sum_family(m, "tendermint_consensus_stalls_total")
+        )
+        self.stall_seconds = _sum_family(
+            m, "tendermint_consensus_stall_seconds"
+        )
 
     def _connect_ws(self) -> None:
         try:
@@ -176,6 +185,8 @@ class NodeMonitor:
             "block_interval_ms": self.block_latency_ms,
             "verify_ms": self.verify_ms,
             "traffic_bytes": self.traffic_bytes,
+            "stalls_total": self.stalls_total,
+            "stall_seconds": self.stall_seconds,
             "uptime_pct": self.uptime_pct,
         }
 
@@ -245,7 +256,8 @@ def main(argv=None) -> int:
                       f"({snap['num_online']}/{snap['num_nodes']} online, "
                       f"height {snap['max_height']})")
                 print(f"{'MONIKER':<16}{'HEIGHT':>8}{'INTERVAL':>10}"
-                      f"{'VERIFY':>9}{'TRAFFIC':>10}{'UPTIME':>8}  ADDR")
+                      f"{'VERIFY':>9}{'TRAFFIC':>10}{'STALL':>9}"
+                      f"{'UPTIME':>8}  ADDR")
                 for n in snap["nodes"]:
                     if n["online"]:
                         suffix = ""
@@ -254,11 +266,19 @@ def main(argv=None) -> int:
                         down = n["downtime_s"]
                         dur = f" {down:.0f}s" if down is not None else ""
                         suffix = f"  (OFFLINE{dur}: {why})"
+                    # actively stalled -> live stall age; past stalls -> count
+                    if n["stall_seconds"] > 0:
+                        stall = f"!{n['stall_seconds']:.0f}s"
+                    elif n["stalls_total"] > 0:
+                        stall = f"x{n['stalls_total']}"
+                    else:
+                        stall = "-"
                     print(
                         f"{n['moniker']:<16}{n['height']:>8}"
                         f"{n['block_interval_ms']:>9}ms"
                         f"{n['verify_ms']:>7}ms"
                         f"{_fmt_bytes(n['traffic_bytes']):>10}"
+                        f"{stall:>9}"
                         f"{n['uptime_pct']:>7}%  "
                         f"{n['addr']}{suffix}"
                     )

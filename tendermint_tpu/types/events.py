@@ -93,6 +93,13 @@ class EventBus(BaseService):
     def unsubscribe_all(self, client_id: str) -> None:
         self._server.unsubscribe_all(client_id)
 
+    def set_on_drop(self, fn) -> None:
+        """Callback(client_id) on every slow-subscriber drop (pubsub.py)."""
+        self._server.set_on_drop(fn)
+
+    def dropped_events(self, client_id: Optional[str] = None):
+        return self._server.dropped_events(client_id)
+
     def _publish(self, event_type: str, data: Any, extra_tags: Optional[Dict[str, str]] = None) -> None:
         tags = {EVENT_TYPE_KEY: event_type}
         if extra_tags:

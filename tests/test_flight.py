@@ -1,0 +1,486 @@
+"""Flight recorder + liveness watchdog + cross-node merge.
+
+Unit tier: FlightRecorder ring semantics (disabled no-op, eviction,
+limit/truncated export, per-peer attribution caps), deterministic
+LivenessWatchdog sampling via check(now=...), pubsub slow-subscriber drop
+accounting, and trace_merge skew math over synthetic dumps.
+
+Harness tier: a real ConsensusState commits a height and the recorder's
+milestones must appear in causal order with correct per-peer attribution;
+a >1/3-silenced net must trip the watchdog with a report naming the
+missing voting power.
+"""
+
+import importlib.util
+import logging
+import os
+import queue
+import sys
+import time
+
+import pytest
+
+from tendermint_tpu.consensus.flight import (
+    MAX_PEERS_PER_RECORD,
+    FlightRecorder,
+)
+from tendermint_tpu.consensus.messages import VoteMessage
+from tendermint_tpu.libs.metrics import NodeMetrics
+from tendermint_tpu.libs.pubsub import Server
+from tendermint_tpu.libs.watchdog import LivenessWatchdog
+from tendermint_tpu.types import BlockID, SignedMsgType
+from tendermint_tpu.types.events import EventBus
+
+from tests.consensus_harness import make_consensus_state, wait_for
+
+
+def _load_trace_merge():
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "scripts", "trace_merge.py",
+    )
+    spec = importlib.util.spec_from_file_location("trace_merge", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["trace_merge"] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# -- recorder unit tier ------------------------------------------------------------
+
+
+class TestFlightRecorder:
+    def test_disabled_hooks_are_noops(self):
+        fr = FlightRecorder()
+        assert fr.enabled is False
+        fr.on_new_round(1, 0)
+        fr.on_proposal(1, 0, "p")
+        fr.on_vote(1, 0, "prevote", "p", 0)
+        fr.on_commit(1, 0, b"\xab")
+        assert len(fr) == 0
+        snap = fr.snapshot()
+        assert snap["enabled"] is False and snap["records"] == []
+
+    def test_records_milestones(self):
+        fr = FlightRecorder(node_id="n0", enabled=True)
+        fr.on_new_round(1, 0)
+        fr.on_proposal(1, 0)  # own proposal: peer "" -> "local"
+        fr.on_block_parts_complete(1)
+        fr.on_vote(1, 0, "prevote", "peerA", 2)
+        fr.on_vote(1, 0, "prevote", "", 0)
+        fr.on_polka(1, 0)
+        fr.on_vote(1, 0, "precommit", "peerB", 1)
+        fr.on_commit(1, 0, b"\xde\xad")
+        fr.on_execute(1, 100, 250)
+        (rec,) = fr.records()
+        assert rec["height"] == 1
+        assert rec["rounds"][0]["round"] == 0
+        assert rec["proposal"]["peer"] == "local"
+        assert rec["block_parts"] is not None
+        pv = rec["prevote"]
+        assert pv["count"] == 2
+        assert pv["first"]["peer"] == "peerA" and pv["last"]["peer"] == "local"
+        assert pv["by_peer"] == {"peerA": 1, "local": 1}
+        assert rec["precommit"]["by_peer"] == {"peerB": 1}
+        assert rec["polka"]["round"] == 0
+        assert rec["commit"]["hash"] == "DEAD"
+        assert rec["exec"] == {"t": 100, "dur_ns": 150}
+
+    def test_proposal_first_sighting_wins(self):
+        fr = FlightRecorder(enabled=True)
+        fr.on_proposal(3, 0, "gossiper")
+        fr.on_proposal(3, 0, "latecomer")
+        (rec,) = fr.records()
+        assert rec["proposal"]["peer"] == "gossiper"
+
+    def test_ring_eviction(self):
+        fr = FlightRecorder(capacity=2, enabled=True)
+        for h in (1, 2, 3):
+            fr.on_new_round(h, 0)
+        assert len(fr) == 2
+        assert fr.evicted() == 1
+        assert [r["height"] for r in fr.records()] == [2, 3]
+        snap = fr.snapshot()
+        assert snap["evicted"] == 1 and snap["total_records"] == 2
+
+    def test_snapshot_limit_and_truncated(self):
+        fr = FlightRecorder(enabled=True)
+        for h in (1, 2, 3):
+            fr.on_new_round(h, 0)
+        full = fr.snapshot()
+        assert full["truncated"] is False and len(full["records"]) == 3
+        cut = fr.snapshot(limit=2)
+        assert cut["truncated"] is True
+        assert [r["height"] for r in cut["records"]] == [2, 3]  # newest N
+        assert cut["total_records"] == 3
+        assert fr.snapshot(limit=0)["records"] == []
+
+    def test_by_peer_overflow_folds(self):
+        fr = FlightRecorder(enabled=True)
+        for i in range(MAX_PEERS_PER_RECORD + 6):
+            fr.on_vote(1, 0, "prevote", f"peer{i}", i)
+        (rec,) = fr.records()
+        by_peer = rec["prevote"]["by_peer"]
+        assert len(by_peer) == MAX_PEERS_PER_RECORD + 1
+        assert by_peer["overflow"] == 6
+        assert rec["prevote"]["count"] == MAX_PEERS_PER_RECORD + 6
+
+    def test_reset_and_resize(self):
+        fr = FlightRecorder(enabled=True)
+        fr.on_new_round(1, 0)
+        fr.reset(capacity=4)
+        assert len(fr) == 0 and fr.capacity == 4 and fr.evicted() == 0
+        with pytest.raises(ValueError):
+            fr.reset(capacity=0)
+
+    def test_from_env(self, monkeypatch):
+        monkeypatch.setenv("TM_FLIGHT", "1")
+        monkeypatch.setenv("TM_FLIGHT_BUFFER", "16")
+        fr = FlightRecorder.from_env()
+        assert fr.enabled is True and fr.capacity == 16
+        monkeypatch.setenv("TM_FLIGHT", "0")
+        monkeypatch.delenv("TM_FLIGHT_BUFFER")
+        fr = FlightRecorder.from_env()
+        assert fr.enabled is False
+
+
+# -- watchdog unit tier ------------------------------------------------------------
+
+
+class TestWatchdogSampling:
+    """Deterministic check(now=...) over an unstarted harness cs."""
+
+    @pytest.fixture()
+    def cs(self):
+        cs, _stubs, bus = make_consensus_state(4, our_index=0)
+        yield cs
+        bus.stop()
+
+    def _wd(self, cs, metrics=None, **kw):
+        kw.setdefault("stall_factor", 2.0)
+        kw.setdefault("min_stall_seconds", 1.0)
+        kw.setdefault("ewma_alpha", 0.5)
+        return LivenessWatchdog(cs, metrics=metrics, **kw)
+
+    def test_stall_onset_and_recovery(self, cs):
+        m = NodeMetrics()
+        wd = self._wd(cs, metrics=m)
+        assert wd.check(now=0.0) is None  # first sample = progress
+        assert wd.check(now=0.5) is None  # idle below threshold
+        report = wd.check(now=1.5)  # idle 1.5 > min_stall 1.0
+        assert report is not None and report["stalled"] is True
+        assert report["height"] == cs.rs.height
+        assert report["stalls_total"] == 1
+        assert wd.report() is not None
+        # still stalled: counter must NOT increment again
+        wd.check(now=2.5)
+        assert wd.status()["stalls_total"] == 1
+        text = m.registry.expose_text()
+        assert "tendermint_consensus_stalls_total 1" in text
+        # progress clears the report and the gauge
+        cs.rs.height += 1
+        assert wd.check(now=3.0) is None
+        assert wd.report() is None
+        assert wd.status()["stalled"] is False
+        gauge_line = next(
+            l for l in m.registry.expose_text().splitlines()
+            if l.startswith("tendermint_consensus_stall_seconds ")
+        )
+        assert float(gauge_line.split()[-1]) == 0.0
+
+    def test_report_names_all_missing_validators(self, cs):
+        wd = self._wd(cs)
+        wd.check(now=0.0)
+        report = wd.check(now=5.0)
+        missing = report["missing_prevotes"]
+        # nothing voted: all 4 validators missing, full power accounted
+        assert len(missing["validators"]) == 4
+        assert missing["power"] == missing["total_power"] == 40
+        assert {v["index"] for v in missing["validators"]} == {0, 1, 2, 3}
+        assert all(v["address"] for v in missing["validators"])
+
+    def test_ewma_amortizes_multi_height_jumps(self, cs):
+        wd = self._wd(cs)
+        wd.check(now=0.0)  # seeds _last_height_at, no EWMA yet
+        assert wd.threshold() == wd.min_stall_seconds
+        cs.rs.height += 5  # five heights land between two samples
+        wd.check(now=10.0)
+        # 10s over 5 heights = 2s/height, not a 10s "block interval"
+        assert wd.status()["block_interval_ewma_seconds"] == 2.0
+        assert wd.threshold() == 4.0  # max(2.0 factor * 2.0s, 1.0s floor)
+        cs.rs.height += 1
+        wd.check(now=11.0)  # ewma_alpha 0.5: 0.5*1 + 0.5*2
+        assert wd.status()["block_interval_ewma_seconds"] == 1.5
+
+    def test_round_progress_defers_stall(self, cs):
+        wd = self._wd(cs)
+        wd.check(now=0.0)
+        cs.rs.round += 1  # round change IS progress (no height yet)
+        assert wd.check(now=5.0) is None
+        assert wd.status()["block_interval_ewma_seconds"] is None
+        assert wd.check(now=5.5) is None  # idle clock restarted
+
+
+class TestWatchdogStallHarness:
+    def test_silenced_majority_trips_watchdog(self):
+        """A running 4-val node whose 3 peer validators never vote must
+        stall; the report names the silent >1/3 (here 3/4) power."""
+        cs, stubs, bus = make_consensus_state(4, our_index=0)
+        m = NodeMetrics()
+        wd = LivenessWatchdog(
+            cs, metrics=m, interval=0.05,
+            stall_factor=3.0, min_stall_seconds=0.6,
+        )
+        cs.start()
+        wd.start()
+        try:
+            assert wait_for(lambda: wd.report() is not None, timeout=15.0), (
+                "watchdog never reported a stall"
+            )
+            report = wd.report()
+            assert report["height"] == 1
+            missing = report["missing_prevotes"]
+            stub_idx = {s.index for s in stubs}
+            assert stub_idx <= {v["index"] for v in missing["validators"]}
+            # the three silent stubs alone are 30/40 power (> 1/3)
+            assert missing["power"] * 3 > missing["total_power"]
+            assert report["threshold_seconds"] >= 0.6
+            text = m.registry.expose_text()
+            assert "tendermint_consensus_stalls_total 1" in text
+        finally:
+            wd.stop()
+            cs.stop()
+            bus.stop()
+
+
+# -- flight milestones on a real consensus height ----------------------------------
+
+
+class TestFlightHarness:
+    def test_milestone_order_and_attribution(self):
+        """Commit height 1 with scripted peers; the record's stamps must be
+        causally ordered and votes attributed to the sending peer ids."""
+        for our_index in range(4):
+            cs, stubs, bus = make_consensus_state(4, our_index=our_index)
+            cs.flight.node_id = "me"
+            cs.flight.enable()
+            cs.start()
+            try:
+                if not wait_for(
+                    lambda: cs.get_round_state().step.value >= 3, timeout=10.0
+                ):
+                    continue
+                if not cs._is_proposer():
+                    continue
+                assert wait_for(
+                    lambda: cs.get_round_state().proposal_block is not None,
+                    timeout=20.0,
+                )
+                rs = cs.get_round_state()
+                bid = BlockID(
+                    hash=rs.proposal_block.hash(),
+                    parts_header=rs.proposal_block_parts.header(),
+                )
+                for stub in stubs:
+                    cs.send_peer_msg(
+                        VoteMessage(
+                            stub.sign_vote(SignedMsgType.PREVOTE, bid, 1, 0)
+                        ),
+                        f"peer{stub.index}",
+                    )
+                for stub in stubs:
+                    cs.send_peer_msg(
+                        VoteMessage(
+                            stub.sign_vote(SignedMsgType.PRECOMMIT, bid, 1, 0)
+                        ),
+                        f"peer{stub.index}",
+                    )
+                # wait for execution AND all 4 votes of each kind: our own
+                # precommit rides the internal queue and can land after the
+                # stub votes already committed the height
+                assert wait_for(
+                    lambda: any(
+                        r["height"] == 1
+                        and r["exec"] is not None
+                        and r["prevote"]["count"] >= 4
+                        and r["precommit"]["count"] >= 4
+                        for r in cs.flight.records()
+                    ),
+                    timeout=20.0,
+                ), "height 1 never executed with all votes recorded"
+                rec = next(
+                    r for r in cs.flight.records() if r["height"] == 1
+                )
+                # every milestone fired
+                for key in ("proposal", "block_parts", "polka", "commit",
+                            "exec"):
+                    assert rec[key] is not None, f"missing {key}"
+                # causal order: round entry <= proposal <= parts-complete
+                # <= first prevote <= polka <= commit
+                t_round = rec["rounds"][0]["t"]
+                t_prop = rec["proposal"]["t"]
+                t_parts = rec["block_parts"]["t"]
+                t_pv = rec["prevote"]["first"]["t"]
+                t_polka = rec["polka"]["t"]
+                t_commit = rec["commit"]["t"]
+                assert t_round <= t_prop <= t_parts <= t_pv
+                assert t_pv <= t_polka <= t_commit
+                assert rec["proposal"]["peer"] == "local"  # our own block
+                assert rec["commit"]["hash"] == bid.hash.hex().upper()
+                assert rec["exec"]["dur_ns"] >= 0
+                # attribution: our vote is "local", each stub its peer id
+                for kind in ("prevote", "precommit"):
+                    by_peer = rec[kind]["by_peer"]
+                    assert by_peer.get("local", 0) >= 1
+                    for stub in stubs:
+                        assert by_peer.get(f"peer{stub.index}") == 1, (
+                            f"{kind} not attributed to peer{stub.index}: "
+                            f"{by_peer}"
+                        )
+                    assert rec[kind]["count"] == 4
+                return
+            finally:
+                cs.stop()
+                bus.stop()
+        pytest.skip("no configuration made our node the proposer")
+
+
+# -- pubsub slow-subscriber drops --------------------------------------------------
+
+
+class TestPubsubDrops:
+    def test_drop_counting_callback_and_first_drop_log(self, caplog):
+        drops = []
+        srv = Server(on_drop=drops.append)
+        sub = srv.subscribe("slow", "tm.event = 'X'", maxsize=1)
+        fast = srv.subscribe("fast", "tm.event = 'X'", maxsize=8)
+        with caplog.at_level(logging.WARNING, logger="pubsub"):
+            for i in range(3):
+                srv.publish(i, {"tm.event": "X"})
+        # queue of 1: first publish lands, two drop
+        assert srv.dropped_events("slow") == 2
+        assert srv.dropped_events("fast") == 0
+        assert srv.dropped_events() == {"slow": 2}
+        assert drops == ["slow", "slow"]
+        warnings = [
+            r for r in caplog.records if "slow subscriber" in r.getMessage()
+        ]
+        assert len(warnings) == 1  # first drop only; rest counted silently
+        assert sub.get(timeout=1).data == 0
+        assert fast.queue.qsize() == 3
+
+    def test_on_drop_exception_does_not_break_publish(self):
+        def boom(client_id):
+            raise RuntimeError("bad callback")
+
+        srv = Server(on_drop=boom)
+        srv.subscribe("slow", "tm.event = 'X'", maxsize=1)
+        srv.publish(1, {"tm.event": "X"})
+        srv.publish(2, {"tm.event": "X"})  # must not raise
+        assert srv.dropped_events("slow") == 1
+
+    def test_event_bus_passthrough(self):
+        bus = EventBus()
+        seen = []
+        bus.set_on_drop(seen.append)
+        assert bus.dropped_events() == {}
+        assert bus.dropped_events("nobody") == 0
+
+
+# -- cross-node merge over synthetic dumps -----------------------------------------
+
+
+def _mk_dump(node_id, commits, skew_ns=0, extra=()):
+    """A minimal dump_flight payload: commits = [(height, hash, t_ns)];
+    skew_ns shifts this node's clock AWAY from the reference."""
+    records = []
+    for h, hsh, t in commits:
+        records.append({
+            "height": h,
+            "rounds": [{"round": 0, "t": t - 1_000_000 - skew_ns}],
+            "proposal": None,
+            "block_parts": None,
+            "prevote": {"first": None, "last": None, "count": 0,
+                        "by_peer": {}},
+            "precommit": {"first": None, "last": None, "count": 0,
+                          "by_peer": {}},
+            "polka": None,
+            "commit": {"t": t - skew_ns, "round": 0, "hash": hsh},
+            "exec": None,
+        })
+    records.extend(extra)
+    return {"node_id": node_id, "enabled": True, "capacity": 512,
+            "evicted": 0, "total_records": len(records),
+            "truncated": False, "records": records}
+
+
+class TestTraceMerge:
+    @pytest.fixture(scope="class")
+    def tm(self):
+        return _load_trace_merge()
+
+    def test_skew_from_shared_commit_anchors(self, tm):
+        base = [(1, "AA", 1_000_000_000), (2, "BB", 2_000_000_000),
+                (3, "CC", 3_000_000_000)]
+        d0 = _mk_dump("n0", base)
+        d1 = _mk_dump("n1", base, skew_ns=5_000_000)  # 5ms behind ref
+        d2 = _mk_dump("n2", base, skew_ns=-2_000_000)  # 2ms ahead
+        skews = tm.compute_skews([d0, d1, d2])
+        assert skews == [0, 5_000_000, -2_000_000]
+        spread = tm.anchor_spread([d0, d1, d2], skews)
+        assert set(spread) == {1, 2, 3}
+        assert all(s == 0.0 for s in spread.values())
+
+    def test_no_shared_anchor_gets_zero_skew(self, tm):
+        d0 = _mk_dump("n0", [(1, "AA", 1_000_000_000)])
+        d1 = _mk_dump("n1", [(9, "ZZ", 9_000_000_000)])
+        assert tm.compute_skews([d0, d1]) == [0, 0]
+        assert tm.anchor_spread([d0, d1], [0, 0]) == {}
+
+    def test_differing_hash_is_not_an_anchor(self, tm):
+        # same height, different hash (e.g. dump raced a re-org) must NOT
+        # align clocks on a non-shared instant
+        d0 = _mk_dump("n0", [(1, "AA", 1_000_000_000)])
+        d1 = _mk_dump("n1", [(1, "XX", 5_000_000_000)])
+        assert tm.compute_skews([d0, d1]) == [0, 0]
+
+    def test_merge_emits_aligned_tracks(self, tm):
+        base = [(1, "AA", 1_000_000_000), (2, "BB", 2_000_000_000)]
+        d0 = _mk_dump("n0", base)
+        d1 = _mk_dump("n1", base, skew_ns=7_000_000)
+        merged = tm.merge([d0, d1])
+        assert merged["displayTimeUnit"] == "ms"
+        assert merged["otherData"]["nodes"] == ["n0", "n1"]
+        assert merged["otherData"]["skews_ns"] == [0, 7_000_000]
+        events = merged["traceEvents"]
+        names = {(e["pid"], e["name"]) for e in events}
+        for pid in (0, 1):
+            assert (pid, "process_name") in names
+            assert (pid, "commit") in names
+        # skew-corrected commits of the same height coincide across tracks
+        commits = [e for e in events if e["name"] == "commit"]
+        by_height = {}
+        for e in commits:
+            by_height.setdefault(e["args"]["height"], []).append(e["ts"])
+        for ts in by_height.values():
+            assert len(ts) == 2 and abs(ts[0] - ts[1]) < 1e-6
+
+    def test_trace_events_rebased_to_wall_clock(self, tm):
+        payload = {
+            "anchor": {"wall_ns": 2_000_000_000, "perf_ns": 500_000_000},
+            "traceEvents": [
+                {"name": "span", "ph": "X", "pid": 99, "tid": 7,
+                 "ts": 100.0, "dur": 5.0},
+                {"name": "thread_name", "ph": "M", "pid": 99, "tid": 7,
+                 "args": {"name": "w"}},
+            ],
+        }
+        events = tm._trace_events(payload, pid=3, skew_ns=1_000_000)
+        span = next(e for e in events if e["ph"] == "X")
+        # perf->wall offset (1.5e9 ns) + skew (1e6 ns), in µs
+        assert span["ts"] == 100.0 + 1_500_000.0 + 1_000.0
+        assert span["pid"] == 3
+        meta = next(e for e in events if e["ph"] == "M")
+        assert meta["pid"] == 3 and "ts" not in meta
+        # a payload without the anchor pair cannot be placed: dropped
+        assert tm._trace_events({"traceEvents": [{}]}, 0, 0) == []

@@ -6,6 +6,7 @@ the strict text-format v0.0.4 linter (scripts/metrics_lint.py).
 import importlib.util
 import json
 import os
+import sys
 import threading
 
 import pytest
@@ -546,6 +547,7 @@ def _load_bench_check():
     )
     spec = importlib.util.spec_from_file_location("bench_check", path)
     mod = importlib.util.module_from_spec(spec)
+    sys.modules["bench_check"] = mod  # @dataclass resolves via sys.modules
     spec.loader.exec_module(mod)
     return mod
 
@@ -556,34 +558,98 @@ class TestBenchCheck:
         return _load_bench_check()
 
     @staticmethod
+    def _specs(bc, *raw, threshold=0.20):
+        raw = raw or (bc.DEFAULT_METRIC,)
+        return [bc.MetricSpec.parse(s, threshold) for s in raw]
+
+    @staticmethod
     def _write(tmp, n, value):
         parsed = None if value is None else {"fastsync_blocks_per_s": value}
+        with open(os.path.join(tmp, f"BENCH_r{n:02d}.json"), "w") as f:
+            json.dump({"round": n, "parsed": parsed}, f)
+
+    @staticmethod
+    def _write_parsed(tmp, n, parsed):
         with open(os.path.join(tmp, f"BENCH_r{n:02d}.json"), "w") as f:
             json.dump({"round": n, "parsed": parsed}, f)
 
     def test_ok_within_threshold(self, bc, tmp_path):
         self._write(tmp_path, 1, 100.0)
         self._write(tmp_path, 2, 90.0)
-        assert bc.check(str(tmp_path), 0.20) == 0
+        assert bc.check(str(tmp_path), self._specs(bc)) == 0
 
     def test_regression_fails(self, bc, tmp_path):
         self._write(tmp_path, 1, 100.0)
         self._write(tmp_path, 2, 70.0)
-        assert bc.check(str(tmp_path), 0.20) == 1
+        assert bc.check(str(tmp_path), self._specs(bc)) == 1
 
     def test_null_parsed_rounds_skipped(self, bc, tmp_path):
         self._write(tmp_path, 1, 100.0)
         self._write(tmp_path, 2, None)  # timed out round
         self._write(tmp_path, 3, 95.0)
         # r02 is skipped; r03 vs r01 is within threshold
-        assert bc.check(str(tmp_path), 0.20) == 0
+        assert bc.check(str(tmp_path), self._specs(bc)) == 0
 
     def test_newest_unparsed_skips(self, bc, tmp_path):
         self._write(tmp_path, 1, 100.0)
         self._write(tmp_path, 2, None)
-        assert bc.check(str(tmp_path), 0.20) == 0
+        assert bc.check(str(tmp_path), self._specs(bc)) == 0
 
     def test_no_baseline_passes(self, bc, tmp_path):
         self._write(tmp_path, 1, 100.0)
-        assert bc.check(str(tmp_path), 0.20) == 0
-        assert bc.check(str(tmp_path / "empty-missing"), 0.20) == 0
+        assert bc.check(str(tmp_path), self._specs(bc)) == 0
+        assert bc.check(str(tmp_path / "empty-missing"), self._specs(bc)) == 0
+
+    def test_spec_parse(self, bc):
+        s = bc.MetricSpec.parse("foo", 0.20)
+        assert (s.name, s.threshold, s.higher_is_better) == ("foo", 0.20, True)
+        s = bc.MetricSpec.parse("foo:0.05", 0.20)
+        assert (s.threshold, s.higher_is_better) == (0.05, True)
+        s = bc.MetricSpec.parse("foo:0.3:lower", 0.20)
+        assert (s.threshold, s.higher_is_better) == (0.3, False)
+        s = bc.MetricSpec.parse("foo::lower", 0.20)  # keep default threshold
+        assert (s.threshold, s.higher_is_better) == (0.20, False)
+        for bad in ("", "foo:1.5", "foo:0", "foo:0.2:sideways", "a:b:c:d"):
+            with pytest.raises(ValueError):
+                bc.MetricSpec.parse(bad, 0.20)
+
+    def test_lower_is_better_direction(self, bc, tmp_path):
+        # latency-style metric: a rise is the regression, a drop is fine
+        self._write_parsed(tmp_path, 1, {"verify_dispatch_ms": 10.0})
+        self._write_parsed(tmp_path, 2, {"verify_dispatch_ms": 14.0})
+        specs = self._specs(bc, "verify_dispatch_ms:0.20:lower")
+        assert bc.check(str(tmp_path), specs) == 1
+        self._write_parsed(tmp_path, 2, {"verify_dispatch_ms": 7.0})
+        assert bc.check(str(tmp_path), specs) == 0
+
+    def test_multi_metric_per_threshold(self, bc, tmp_path):
+        self._write_parsed(
+            tmp_path, 1, {"fastsync_blocks_per_s": 100.0, "lat_ms": 10.0}
+        )
+        self._write_parsed(
+            tmp_path, 2, {"fastsync_blocks_per_s": 95.0, "lat_ms": 13.0}
+        )
+        # throughput fine at 20%, latency gated separately at 10% -> fails
+        specs = self._specs(
+            bc, "fastsync_blocks_per_s:0.20", "lat_ms:0.10:lower"
+        )
+        assert bc.check(str(tmp_path), specs) == 1
+        # loosen the latency gate and the same ledger passes
+        specs = self._specs(
+            bc, "fastsync_blocks_per_s:0.20", "lat_ms:0.50:lower"
+        )
+        assert bc.check(str(tmp_path), specs) == 0
+
+    def test_metric_missing_from_round_skips(self, bc, tmp_path):
+        # a spec whose metric no round carries must not gate
+        self._write_parsed(tmp_path, 1, {"fastsync_blocks_per_s": 100.0})
+        self._write_parsed(tmp_path, 2, {"fastsync_blocks_per_s": 95.0})
+        specs = self._specs(bc, "nonexistent_metric:0.01:lower")
+        assert bc.check(str(tmp_path), specs) == 0
+
+    def test_main_default_matches_legacy_gate(self, bc, tmp_path):
+        self._write(tmp_path, 1, 100.0)
+        self._write(tmp_path, 2, 70.0)
+        assert bc.main(["--dir", str(tmp_path)]) == 1
+        assert bc.main(["--dir", str(tmp_path), "--threshold", "0.45"]) == 0
+        assert bc.main(["--metric", "bogus:2.0"]) == 2  # bad spec

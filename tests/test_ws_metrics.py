@@ -407,3 +407,87 @@ class TestProfileExport:
                 assert "error" in json.loads(body)
         finally:
             live_node.config.rpc.unsafe = True
+
+
+class TestFlightExport:
+    def test_flight_reset_dump_and_limit(self, live_node):
+        """Enable the per-node flight recorder over RPC, let a couple of
+        heights commit, and pull limited + full dumps."""
+        _, body = _rpc_get(live_node, "/flight_reset?enable=true")
+        try:
+            assert json.loads(body)["result"]["enabled"] is True
+            h0 = live_node.block_store.height()
+            assert wait_for(
+                lambda: live_node.block_store.height() >= h0 + 2, timeout=30
+            )
+            status, body = _rpc_get(live_node, "/dump_flight")
+            assert status == 200
+            out = json.loads(body)["result"]
+            assert out["enabled"] is True
+            assert out["truncated"] is False
+            assert out["total_records"] == len(out["records"]) >= 2
+            # default-on watchdog contributes the stall key (healthy: null)
+            assert "stall" in out and out["stall"] is None
+            # the newest record may still be mid-height: assert on a fully
+            # executed one (commit stamps before apply_block finishes)
+            done = [r for r in out["records"] if r["exec"] is not None]
+            assert done, "no executed height in flight records"
+            rec = done[-1]
+            assert rec["commit"] is not None and rec["commit"]["hash"]
+            assert rec["prevote"]["count"] >= 1  # single validator: own vote
+            assert rec["prevote"]["by_peer"].get("local", 0) >= 1
+            assert rec["exec"]["dur_ns"] >= 0
+            # limit keeps the newest record and flags the cut
+            cut = json.loads(
+                _rpc_get(live_node, "/dump_flight?limit=1")[1]
+            )["result"]
+            assert len(cut["records"]) == 1 and cut["truncated"] is True
+            # >= not ==: the node may have started a new height in between
+            assert cut["records"][0]["height"] >= out["records"][-1]["height"]
+        finally:
+            _rpc_get(live_node, "/flight_reset?enable=false")
+
+    def test_dump_trace_limit_and_anchor(self, live_node):
+        from tendermint_tpu.libs import trace
+
+        _rpc_get(live_node, "/trace_reset?enable=true")
+        try:
+            h0 = live_node.block_store.height()
+            assert wait_for(
+                lambda: live_node.block_store.height() >= h0 + 1, timeout=30
+            )
+            out = json.loads(
+                _rpc_get(live_node, "/dump_trace?limit=5")[1]
+            )["result"]
+            spans = [e for e in out["traceEvents"] if e["ph"] != "M"]
+            assert len(spans) <= 5
+            assert out["total_events"] > 5 and out["truncated"] is True
+            # the wall/perf anchor pair trace_merge.py rebases with
+            anchor = out["anchor"]
+            assert anchor["wall_ns"] > 0 and anchor["perf_ns"] > 0
+        finally:
+            trace.disable()
+            trace.reset()
+
+    def test_flight_routes_gated(self, live_node):
+        live_node.config.rpc.unsafe = False
+        try:
+            for route in ("/dump_flight", "/flight_reset"):
+                _, body = _rpc_get(live_node, route)
+                assert "error" in json.loads(body)
+        finally:
+            live_node.config.rpc.unsafe = True
+
+    def test_flight_rejects_bad_args(self, live_node):
+        _, body = _rpc_get(live_node, "/flight_reset?capacity=0")
+        assert "error" in json.loads(body)
+        _, body = _rpc_get(live_node, "/dump_flight?limit=-1")
+        assert "error" in json.loads(body)
+
+    def test_health_and_dump_consensus_state_carry_watchdog(self, live_node):
+        _, body = _rpc_get(live_node, "/health")
+        h = json.loads(body)["result"]
+        assert h["stalled"] is False and h["stalls_total"] == 0
+        _, body = _rpc_get(live_node, "/dump_consensus_state")
+        out = json.loads(body)["result"]
+        assert out["stall"]["stalled"] is False
