@@ -4,8 +4,8 @@ PYTHON ?= python
 
 .PHONY: test test_slow test_sanitizers bench bench-local bench_fastsync \
         planner-bench bench_secp bench_multisig metrics-lint bench-check \
-        statesync-smoke flight-smoke localnet-start localnet-stop \
-        build-docker-localnode
+        statesync-smoke flight-smoke chaos-smoke localnet-start \
+        localnet-stop build-docker-localnode
 
 test:
 	$(PYTHON) -m pytest tests/ -q
@@ -58,6 +58,12 @@ statesync-smoke:
 # Chrome trace-event JSON with agreeing commit anchors
 flight-smoke:
 	JAX_PLATFORMS=cpu $(PYTHON) scripts/flight_smoke.py
+
+# deterministic chaos/Byzantine scenario matrix over the in-proc sim fabric:
+# safety + liveness + seeded-fault replayability per scenario, run-to-run
+# commit-hash determinism, merged Chrome trace emitted on any failure
+chaos-smoke:
+	JAX_PLATFORMS=cpu $(PYTHON) scripts/chaos_smoke.py
 
 build-docker-localnode:
 	docker build -t tendermint_tpu/localnode networks/local/localnode

@@ -59,6 +59,47 @@ def compute_skews(dumps: List[dict]) -> List[int]:
     return skews
 
 
+# below this many shared anchors the median is one sample (or none): the
+# skew is a guess, and the merged view must SAY so instead of silently
+# rendering misaligned tracks that look like real latency
+MIN_SHARED_ANCHORS = 2
+
+
+def alignment_warnings(dumps: List[dict]) -> List[str]:
+    """Human-readable diagnostics for degenerate anchor overlap.  Empty means
+    every non-reference node shares >= MIN_SHARED_ANCHORS commit anchors with
+    the reference, i.e. the skew medians are trustworthy."""
+    if not dumps:
+        return ["nothing to merge: no flight dumps"]
+    if len(dumps) == 1:
+        return []  # single node: its own clock IS the timeline
+    warns = []
+    ref = _commit_anchors(dumps[0])
+    ref_name = dumps[0].get("node_id") or "node0"
+    if not ref:
+        warns.append(
+            f"reference node {ref_name} has no commit anchors (no committed "
+            f"heights in its dump) — cross-node clock alignment is impossible; "
+            f"all tracks stay on their own clocks"
+        )
+    for i, dump in enumerate(dumps[1:], start=1):
+        name = dump.get("node_id") or f"node{i}"
+        shared = len(_commit_anchors(dump).keys() & ref.keys())
+        if shared == 0:
+            warns.append(
+                f"{name}: no commit anchors shared with {ref_name} — skew "
+                f"unknown, timestamps left uncorrected (skew 0); expect "
+                f"misaligned tracks"
+            )
+        elif shared < MIN_SHARED_ANCHORS:
+            warns.append(
+                f"{name}: only {shared} commit anchor shared with {ref_name} "
+                f"— skew rests on a single sample; capture more committed "
+                f"heights for a robust median"
+            )
+    return warns
+
+
 def anchor_spread(dumps: List[dict], skews: List[int]) -> Dict[int, float]:
     """Per-height max disagreement (seconds) of skew-corrected commit times
     across nodes — the residual alignment error.  Only heights committed by
@@ -188,6 +229,7 @@ def merge(dumps: List[dict], traces: Optional[List[Optional[dict]]] = None,
             "nodes": [d.get("node_id") or f"node{i}"
                       for i, d in enumerate(dumps)],
             "skews_ns": list(skews),
+            "alignment_warnings": alignment_warnings(dumps),
         },
     }
 
@@ -236,6 +278,8 @@ def main(argv=None) -> int:
     )
     print(f"skews_ns={skews} shared_heights={len(spread)} "
           f"worst_anchor_spread_s={worst}")
+    for warn in alignment_warnings(dumps):
+        print(f"WARNING: {warn}", file=sys.stderr)
     return 0
 
 

@@ -52,6 +52,9 @@ class FlightRecorder:
         self._mtx = threading.Lock()
         self.enabled = enabled
         self.node_id = node_id
+        # wall-clock source for every stamp; per-instance so the sim
+        # harness can inject skewed/frozen clocks node by node
+        self.now_ns = _now_ns
         self._configure(capacity)
 
     @classmethod
@@ -123,7 +126,7 @@ class FlightRecorder:
     def on_new_round(self, height: int, round: int) -> None:
         if not self.enabled:
             return
-        t = _now_ns()
+        t = self.now_ns()
         with self._mtx:
             self._rec(height)["rounds"].append({"round": round, "t": t})
 
@@ -134,7 +137,7 @@ class FlightRecorder:
         call wins — it IS the first-seen time."""
         if not self.enabled:
             return
-        t = _now_ns()
+        t = self.now_ns()
         with self._mtx:
             rec = self._rec(height)
             if rec["proposal"] is None:
@@ -145,7 +148,7 @@ class FlightRecorder:
     def on_block_parts_complete(self, height: int) -> None:
         if not self.enabled:
             return
-        t = _now_ns()
+        t = self.now_ns()
         with self._mtx:
             rec = self._rec(height)
             if rec["block_parts"] is None:
@@ -157,7 +160,7 @@ class FlightRecorder:
         "prevote" | "precommit"; peer_id "" means our own/internal vote."""
         if not self.enabled:
             return
-        t = _now_ns()
+        t = self.now_ns()
         peer = peer_id or "local"
         with self._mtx:
             slot = self._rec(height)[kind]
@@ -175,7 +178,7 @@ class FlightRecorder:
     def on_polka(self, height: int, round: int) -> None:
         if not self.enabled:
             return
-        t = _now_ns()
+        t = self.now_ns()
         with self._mtx:
             rec = self._rec(height)
             if rec["polka"] is None:
@@ -184,7 +187,7 @@ class FlightRecorder:
     def on_commit(self, height: int, round: int, block_hash: bytes = b"") -> None:
         if not self.enabled:
             return
-        t = _now_ns()
+        t = self.now_ns()
         with self._mtx:
             rec = self._rec(height)
             if rec["commit"] is None:

@@ -50,6 +50,7 @@ from tendermint_tpu.types import (
     Vote,
     VoteSet,
 )
+from tendermint_tpu.types.part_set import PartSetError
 from tendermint_tpu.types.events import (
     EVENT_COMPLETE_PROPOSAL,
     EVENT_LOCK,
@@ -105,6 +106,9 @@ class ConsensusState(BaseService):
         # per-height lifecycle ledger; disabled unless TM_FLIGHT /
         # [instrumentation] flight_recorder / flight_reset turns it on
         self.flight = FlightRecorder.from_env()
+        # wall-clock source for proposal/vote timestamps and latency
+        # accounting; the sim harness swaps in a skewed/frozen clock
+        self.now_ns: Callable[[], int] = time.time_ns
         # step-duration accounting: each _new_step observes the wall time
         # spent in the step being LEFT (None until the first transition)
         self._step_started: Optional[float] = None
@@ -399,7 +403,19 @@ class ConsensusState(BaseService):
                 if isinstance(msg, ProposalMessage):
                     self.set_proposal_fn(msg.proposal)
                 elif isinstance(msg, BlockPartMessage):
-                    self._add_proposal_block_part(msg, peer_id)
+                    # PartSetError covers a catch-up race, not just malice: a
+                    # peer pushes parts of the committed block while our part
+                    # set still has the header of a stale same-height
+                    # proposal (enter_commit resets it once the commit-round
+                    # precommits land) — log and keep consuming, like the
+                    # reference's handleMsg (state.go:701)
+                    try:
+                        self._add_proposal_block_part(msg, peer_id)
+                    except PartSetError as e:
+                        self.logger.debug(
+                            "block part rejected h=%d r=%d from %s: %s",
+                            msg.height, msg.round, peer_id, e,
+                        )
                 elif isinstance(msg, VoteMessage):
                     self._try_add_vote(msg.vote, peer_id)
                 else:
@@ -532,7 +548,7 @@ class ConsensusState(BaseService):
         proposal = Proposal(
             height=height,
             round=round,
-            timestamp_ns=time.time_ns(),
+            timestamp_ns=self.now_ns(),
             block_id=prop_block_id,
             pol_round=rs.valid_round,
         )
@@ -802,7 +818,7 @@ class ConsensusState(BaseService):
         fail.fail_point()
 
         state_copy = self.state.copy()
-        exec_t0 = time.time_ns()
+        exec_t0 = self.now_ns()
         try:
             state_copy = self.block_exec.apply_block(
                 state_copy, BlockID(hash=block.hash(), parts_header=block_parts.header()),
@@ -811,7 +827,7 @@ class ConsensusState(BaseService):
         except Exception as e:
             self.logger.error("error on ApplyBlock: %s — halting", e)
             raise
-        self.flight.on_execute(height, exec_t0, time.time_ns())
+        self.flight.on_execute(height, exec_t0, self.now_ns())
 
         fail.fail_point()
 
@@ -919,7 +935,7 @@ class ConsensusState(BaseService):
         histogram."""
         if self.metrics is None:
             return
-        lat = (time.time_ns() - vote.timestamp_ns) / 1e9
+        lat = (self.now_ns() - vote.timestamp_ns) / 1e9
         if 0.0 <= lat < 3600.0:
             kind = (
                 "prevote"
@@ -1048,7 +1064,7 @@ class ConsensusState(BaseService):
 
     # ----------------------------------------------------------------- votes
     def _vote_time_ns(self) -> int:
-        now = time.time_ns()
+        now = self.now_ns()
         min_vote_time = now
         rs = self.rs
         if rs.locked_block is not None:

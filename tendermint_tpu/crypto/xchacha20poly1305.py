@@ -5,15 +5,19 @@ Construction mirrors the reference exactly: the first 16 nonce bytes feed
 HChaCha20 to derive a subkey; the remaining 8 become the tail of a 12-byte
 IETF ChaCha20-Poly1305 nonce (prefixed with 4 zero bytes, xchachapoly.go:74-80).
 HChaCha20 is pure Python (one 64-byte block per seal — not a hot path); the
-bulk AEAD rides the `cryptography` C implementation.
+bulk AEAD rides the `cryptography` C implementation when installed, else
+the RFC-vector-validated pure-Python fallback (crypto/sts_fallback.py).
 """
 
 from __future__ import annotations
 
 import struct
 
-from cryptography.exceptions import InvalidTag
-from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
+try:
+    from cryptography.exceptions import InvalidTag
+    from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
+except ImportError:  # pragma: no cover - environment-dependent
+    from tendermint_tpu.crypto.sts_fallback import ChaCha20Poly1305, InvalidTag
 
 KEY_SIZE = 32
 NONCE_SIZE = 24

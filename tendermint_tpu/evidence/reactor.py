@@ -97,9 +97,16 @@ class EvidenceReactor(Reactor):
 
     def _broadcast_routine(self, peer) -> None:
         def hold_back(ev) -> bool:
-            # peer can't verify evidence above its own height
+            # Peer can't verify evidence above its own height.  When the
+            # lookup is wired but hasn't reported a height yet (peer still
+            # handshaking/syncing), hold back too: treating unknown as
+            # send-now used to blast evidence at peers that then failed
+            # verification and punished US.  Only a reactor deliberately
+            # running standalone (no lookup at all) broadcasts eagerly.
+            if self._peer_height_lookup is None:
+                return False
             h = self._peer_height(peer.id)
-            return h is not None and h < ev.height
+            return h is None or h < ev.height
 
         walk_and_send(
             alive=lambda: self.is_running and peer.is_running,

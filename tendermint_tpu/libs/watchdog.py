@@ -51,10 +51,15 @@ class LivenessWatchdog:
         min_stall_seconds: float = DEFAULT_MIN_STALL_SECONDS,
         ewma_alpha: float = DEFAULT_EWMA_ALPHA,
         logger: Optional[logging.Logger] = None,
+        now_ns=None,
     ):
         self.cons = consensus_state
         self.switch = switch
         self.metrics = metrics
+        # wall-clock source stamped into stall reports (cross-node fusable);
+        # sampling/thresholds stay on time.monotonic. The sim harness injects
+        # each node's skewed clock here so reports land on its timeline.
+        self.now_ns = now_ns or time.time_ns
         self.interval = interval
         self.stall_factor = stall_factor
         self.min_stall_seconds = min_stall_seconds
@@ -215,6 +220,7 @@ class LivenessWatchdog:
             precommits = None
         return {
             "stalled": True,
+            "wall_time_ns": self.now_ns(),
             "height": rs.height,
             "round": rs.round,
             "step": rs.step.name,

@@ -30,9 +30,10 @@ import threading
 import time
 from typing import Optional
 
-# `cryptography` is only needed once a real peer connection is upgraded; a
-# missing install must not take down every module that imports the p2p tree
-# (blockchain.reactor, benches, single-node RPC setups run fine without it)
+# `cryptography` gives the C-speed data plane; without it the pure-Python
+# fallback (crypto/sts_fallback.py, RFC-vector validated) keeps the STS
+# handshake and framed AEAD channel fully functional — slower, but correct
+# and wire-compatible, so mixed deployments interoperate.
 try:
     from cryptography.hazmat.primitives import hashes
     from cryptography.hazmat.primitives.asymmetric.x25519 import (
@@ -42,11 +43,17 @@ try:
     from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
     from cryptography.hazmat.primitives.kdf.hkdf import HKDF
 
-    _CRYPTO_ERR = None
-except ImportError as _e:  # pragma: no cover - environment-dependent
-    hashes = X25519PrivateKey = X25519PublicKey = None
-    ChaCha20Poly1305 = HKDF = None
-    _CRYPTO_ERR = _e
+    STS_BACKEND = "cryptography"
+except ImportError:  # pragma: no cover - environment-dependent
+    from tendermint_tpu.crypto.sts_fallback import (
+        HKDF,
+        ChaCha20Poly1305,
+        X25519PrivateKey,
+        X25519PublicKey,
+        hashes,
+    )
+
+    STS_BACKEND = "fallback"
 
 from tendermint_tpu.crypto.keys import _PUBKEY_TYPES, PrivKey, PubKey
 from tendermint_tpu.encoding.codec import Reader, Writer, length_prefix
@@ -139,11 +146,6 @@ class SecretConnection:
     def __init__(self, conn: RawConn, local_priv: PrivKey):
         """Performs the full handshake; raises HandshakeError on failure.
         Caller owns closing `conn`."""
-        if _CRYPTO_ERR is not None:
-            raise HandshakeError(
-                f"secret connection needs the 'cryptography' package: "
-                f"{_CRYPTO_ERR}"
-            )
         self._conn = conn
         self._send_lock = threading.Lock()
         self._recv_lock = threading.Lock()

@@ -1,0 +1,37 @@
+"""Deterministic chaos/Byzantine simulation harness.
+
+Runs N REAL ConsensusStates (mempool, evidence pool, evidence reactor
+included) over an in-proc simulated transport (`p2p/inproc.py` +
+`SimNet`), with seeded per-link fault injection, partitions, clock skew,
+validator churn, and Byzantine signer wrappers.  `scenario.py` is the
+timed fault-schedule DSL; `scenarios.py` the named scenario matrix that
+`scripts/chaos_smoke.py` / `make chaos-smoke` executes.
+"""
+
+from tendermint_tpu.sim.byzantine import EquivocatingPV
+from tendermint_tpu.sim.clock import SimClock
+from tendermint_tpu.sim.node import SimNode, build_sim_net
+from tendermint_tpu.sim.scenario import (
+    FaultOp,
+    Scenario,
+    ScenarioResult,
+    round0_clean_top,
+    run_scenario,
+)
+from tendermint_tpu.sim.scenarios import SCENARIOS
+from tendermint_tpu.sim.simnet import LinkPolicy, SimNet
+
+__all__ = [
+    "EquivocatingPV",
+    "FaultOp",
+    "LinkPolicy",
+    "SCENARIOS",
+    "Scenario",
+    "ScenarioResult",
+    "SimClock",
+    "SimNet",
+    "SimNode",
+    "build_sim_net",
+    "round0_clean_top",
+    "run_scenario",
+]

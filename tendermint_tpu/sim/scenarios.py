@@ -1,0 +1,382 @@
+"""The named chaos scenario matrix `scripts/chaos_smoke.py` executes.
+
+Each entry is a zero-arg factory returning a fresh `Scenario`; factories
+keep runs independent (mutable config/clock objects are per-run).  The
+shared invariants (safety at every committed height, seeded-fault
+replayability) are asserted by `run_scenario` for every scenario; the
+per-scenario `check`/`drive` hooks add the scenario-specific claims named
+in the table below.
+
+====================  =====================================================
+baseline_determinism  frozen clocks + blocktime_iota → commit hashes are a
+                      pure function of the chain; the smoke runs it twice
+                      and requires identical hashes
+partition_heal        2-2 split: no quorum ⇒ no progress while split,
+                      progress resumes within budget after heal
+storm                 delay + jitter + 10% drop + duplicates + reorder on
+                      every link; chain still advances
+clock_skew            ±2s wall-clock skews; trace_merge's commit-anchor
+                      math must recover the injected skews
+churn                 validator removed via val-tx, then re-added; the
+                      valset transitions apply and consensus keeps going
+equivocation          Byzantine double-signer ⇒ DuplicateVoteEvidence is
+                      minted, gossiped, included in a block, committed,
+                      and marked committed in every pool
+silence_watchdog      >1/3 power silenced ⇒ watchdog stall report names
+                      the silenced validators' cumulative power; heals
+====================  =====================================================
+"""
+
+from __future__ import annotations
+
+import base64
+from typing import Callable, Dict, List
+
+from tendermint_tpu.config.config import test_config
+from tendermint_tpu.sim.byzantine import EquivocatingPV
+from tendermint_tpu.sim.clock import SimClock
+from tendermint_tpu.sim.node import SIM_GENESIS_TIME_NS
+from tendermint_tpu.sim.scenario import FaultOp, Scenario, ScenarioRun
+
+# injected clock skews for the clock_skew scenario, ns (index-aligned)
+SKEWS_NS = [0, 2_000_000_000, -1_500_000_000, 500_000_000]
+
+
+def _deterministic_config():
+    """Frozen-clock determinism needs strictly increasing block times, which
+    the iota floor provides (vote time = block time + iota when `now` is
+    frozen), AND it needs every height to commit at round 0: a timeout-driven
+    round bump changes the proposer and therefore the block hash, so all
+    round timeouts are set far above in-proc delivery latency — progress is
+    carried entirely by the has-all fast paths (skip_timeout_commit)."""
+    cfg = test_config()
+    cfg.consensus.blocktime_iota = 1.0
+    cfg.consensus.timeout_propose = 10.0
+    cfg.consensus.timeout_prevote = 5.0
+    cfg.consensus.timeout_precommit = 5.0
+    cfg.consensus.timeout_commit = 5.0
+    return cfg
+
+
+def baseline_determinism() -> Scenario:
+    return Scenario(
+        name="baseline_determinism",
+        description="fault-free frozen-clock run; commit hashes must be "
+                    "identical across nodes AND across runs of one seed",
+        seed=1,
+        target_height=4,
+        timeout_s=60.0,
+        config_factory=_deterministic_config,
+        clock_factory=lambda i: SimClock(frozen_at_ns=SIM_GENESIS_TIME_NS),
+        check=_check_all_nodes_agree_everywhere,
+    )
+
+
+def _check_all_nodes_agree_everywhere(run: ScenarioRun) -> List[str]:
+    """Beyond pairwise safety: every node committed the SAME chain prefix."""
+    failures = []
+    maps = [n.committed_hashes() for n in run.nodes]
+    shared = set(maps[0])
+    for m in maps[1:]:
+        shared &= set(m)
+    if len(shared) < run.scenario.target_height:
+        failures.append(
+            f"only {len(shared)} heights committed by every node "
+            f"(want >= {run.scenario.target_height})"
+        )
+    for h in sorted(shared):
+        hashes = {m[h] for m in maps}
+        if len(hashes) > 1:
+            failures.append(f"height {h}: nodes disagree: {hashes}")
+    return failures
+
+
+def partition_heal() -> Scenario:
+    def drive(run: ScenarioRun) -> List[str]:
+        failures = []
+        if not run.wait_height(2, 30.0):
+            return [f"never reached height 3 pre-partition: {run.heights()}"]
+        run.fabric.set_partition(
+            [{run.nodes[0].node_id, run.nodes[1].node_id},
+             {run.nodes[2].node_id, run.nodes[3].node_id}]
+        )
+        # let in-flight messages settle, then sample the frozen heights
+        run.wait_for(lambda: False, timeout=1.0)
+        before = run.mark("partition_settled")["heights"]
+        run.wait_for(lambda: False, timeout=3.0)
+        after = run.mark("partition_end")["heights"]
+        if before != after:
+            failures.append(
+                f"progress during 2-2 partition: {before} -> {after}"
+            )
+        run.fabric.heal_partition()
+        if not run.wait_height(max(after) + 2, 30.0):
+            failures.append(
+                f"liveness: no progress within budget after heal: "
+                f"{run.heights()} (was {after})"
+            )
+        return failures
+
+    return Scenario(
+        name="partition_heal",
+        description="2-2 partition freezes the chain (no 2/3 quorum); "
+                    "healing restores progress within budget",
+        seed=2,
+        timeout_s=90.0,
+        drive=drive,
+    )
+
+
+def storm() -> Scenario:
+    storm_policy = dict(delay_s=0.005, jitter_s=0.015, drop=0.10,
+                        duplicate=0.10, reorder=0.20, reorder_extra_s=0.05)
+    return Scenario(
+        name="storm",
+        description="drop/duplicate/reorder storm on every link; the chain "
+                    "still advances (gossip retransmission heals losses)",
+        seed=3,
+        target_height=4,
+        timeout_s=120.0,
+        ops=[FaultOp(at_s=0.0, op="policy",
+                     kwargs={"src": None, "dst": None,
+                             "policy": storm_policy})],
+    )
+
+
+def _skew_config():
+    # non-zero iota keeps median block time strictly increasing even for
+    # validators whose skewed clock lags the latest block time
+    cfg = test_config()
+    cfg.consensus.blocktime_iota = 1.0
+    return cfg
+
+
+def clock_skew() -> Scenario:
+    def check(run: ScenarioRun) -> List[str]:
+        import importlib.util
+        import os
+
+        spec = importlib.util.spec_from_file_location(
+            "trace_merge",
+            os.path.join(os.path.dirname(os.path.dirname(
+                os.path.dirname(os.path.abspath(__file__)))),
+                "scripts", "trace_merge.py"),
+        )
+        tm = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(tm)
+
+        failures = []
+        dumps = [n.cs.flight.snapshot() for n in run.nodes]
+        recovered = tm.compute_skews(dumps)
+        for i, skew in enumerate(recovered):
+            expected = SKEWS_NS[0] - SKEWS_NS[i]
+            err_s = abs(skew - expected) / 1e9
+            if err_s > 0.3:
+                failures.append(
+                    f"node {i}: recovered skew {skew / 1e9:+.3f}s vs "
+                    f"injected {expected / 1e9:+.3f}s (err {err_s:.3f}s)"
+                )
+        return failures
+
+    return Scenario(
+        name="clock_skew",
+        description="±2s wall-clock skews; commit-anchor skew recovery in "
+                    "trace_merge must measure the injected offsets back out",
+        seed=4,
+        target_height=5,
+        timeout_s=60.0,
+        config_factory=_skew_config,
+        clock_factory=lambda i: SimClock(skew_ns=SKEWS_NS[i]),
+        check=check,
+    )
+
+
+def churn() -> Scenario:
+    from tendermint_tpu.abci.examples.kvstore import PersistentKVStoreApp
+
+    def drive(run: ScenarioRun) -> List[str]:
+        failures = []
+        # target the validator that sorts LAST in the valset (index 3)
+        last_val = run.nodes[3].pv.get_pub_key()
+        pub_b64 = base64.b64encode(last_val.bytes())
+
+        if not run.wait_height(2, 30.0):
+            return [f"never warmed up: {run.heights()}"]
+        for node in run.nodes:
+            try:
+                node.mempool.check_tx(b"val:" + pub_b64 + b"!0")
+            except Exception:
+                pass
+        removed = run.wait_for(
+            lambda: all(n.cs.rs.validators.size == 3 for n in run.nodes),
+            timeout=30.0,
+        )
+        if not removed:
+            sizes = [n.cs.rs.validators.size for n in run.nodes]
+            return [f"validator never removed: valset sizes {sizes}"]
+        run.mark("removed")
+        h_removed = max(run.heights())
+        if not run.wait_height(h_removed + 1, 30.0):
+            failures.append(
+                f"3-validator net stopped: {run.heights()}"
+            )
+        for node in run.nodes:
+            try:
+                node.mempool.check_tx(b"val:" + pub_b64 + b"!10")
+            except Exception:
+                pass
+        readded = run.wait_for(
+            lambda: all(n.cs.rs.validators.size == 4 for n in run.nodes),
+            timeout=30.0,
+        )
+        if not readded:
+            sizes = [n.cs.rs.validators.size for n in run.nodes]
+            failures.append(f"validator never re-added: valset sizes {sizes}")
+        run.mark("readded")
+        h_readded = max(run.heights())
+        if not run.wait_height(h_readded + 1, 30.0):
+            failures.append(
+                f"4-validator net stopped after re-add: {run.heights()}"
+            )
+        return failures
+
+    return Scenario(
+        name="churn",
+        description="validator removed via val-tx (applies at height+2), "
+                    "chain keeps going on 3, validator re-added, back to 4",
+        seed=5,
+        timeout_s=120.0,
+        app_factory=lambda i: PersistentKVStoreApp(),
+        drive=drive,
+    )
+
+
+def equivocation() -> Scenario:
+    def setup(run: ScenarioRun) -> None:
+        run.nodes[3].start_equivocation_pump()
+
+    def drive(run: ScenarioRun) -> List[str]:
+        def pools_marked() -> bool:
+            # the block lands in the store a beat before apply_block marks
+            # the pool, so wait for BOTH before handing to the check hook
+            for n in run.nodes:
+                heights = n.committed_evidence_heights()
+                if not heights:
+                    return False
+                for h in heights:
+                    block = n.block_store.load_block(h)
+                    for ev in block.evidence.evidence:
+                        if not n.evpool.is_committed(ev):
+                            return False
+            return True
+
+        failures = []
+        if not run.wait_for(pools_marked, timeout=90.0):
+            got = [n.committed_evidence_heights() for n in run.nodes]
+            failures.append(
+                f"evidence never committed+marked on every node: {got}"
+            )
+        return failures
+
+    def check(run: ScenarioRun) -> List[str]:
+        failures = []
+        byz_addr = run.nodes[3].pv.get_pub_key().address()
+        for node in run.nodes:
+            for h in node.committed_evidence_heights():
+                block = node.block_store.load_block(h)
+                for ev in block.evidence.evidence:
+                    if ev.address != byz_addr:
+                        failures.append(
+                            f"{node.node_id}: evidence at h={h} names "
+                            f"{ev.address.hex()[:12]}, not the equivocator"
+                        )
+                    if not node.evpool.is_committed(ev):
+                        failures.append(
+                            f"{node.node_id}: committed evidence at h={h} "
+                            f"not marked committed in the pool"
+                        )
+                    if ev in node.evpool.pending_evidence():
+                        failures.append(
+                            f"{node.node_id}: committed evidence still "
+                            f"pending"
+                        )
+        return failures
+
+    return Scenario(
+        name="equivocation",
+        description="Byzantine double-signer: honest nodes mint "
+                    "DuplicateVoteEvidence, gossip it, a proposer includes "
+                    "it, and every pool marks it committed",
+        seed=6,
+        timeout_s=120.0,
+        byzantine={3: lambda pv: EquivocatingPV(pv, start_height=2)},
+        setup=setup,
+        drive=drive,
+        check=check,
+    )
+
+
+def silence_watchdog() -> Scenario:
+    def drive(run: ScenarioRun) -> List[str]:
+        failures = []
+        if not run.wait_height(3, 30.0):
+            return [f"never warmed up: {run.heights()}"]
+        # start the watchdog only after JAX compile warm-up, then give it a
+        # couple of healthy samples to seed the block-interval EWMA
+        wd = run.nodes[0].start_watchdog(
+            interval=0.2, stall_factor=3.0, min_stall_seconds=1.5
+        )
+        run.wait_for(lambda: False, timeout=1.0)
+        run.fabric.silence({run.nodes[2].node_id, run.nodes[3].node_id})
+        if not run.wait_for(lambda: wd.report() is not None, timeout=10.0):
+            failures.append("watchdog never reported the >1/3-silence stall")
+        else:
+            report = wd.report()
+            missing = report["missing_precommits"]
+            if missing["total_power"] <= 0:
+                failures.append("stall report has no total power")
+            elif missing["power"] * 3 < missing["total_power"]:
+                failures.append(
+                    f"stall report names {missing['power']}/"
+                    f"{missing['total_power']} missing power (< 1/3)"
+                )
+            silenced_addrs = {
+                run.nodes[2].pv.get_pub_key().address().hex().upper(),
+                run.nodes[3].pv.get_pub_key().address().hex().upper(),
+            }
+            named = {v["address"] for v in missing["validators"]}
+            if not silenced_addrs <= named:
+                failures.append(
+                    f"stall report names {named}, missing silenced "
+                    f"{silenced_addrs}"
+                )
+            if "wall_time_ns" not in report:
+                failures.append("stall report lacks wall_time_ns stamp")
+        run.fabric.unsilence()
+        h = max(run.heights())
+        if not run.wait_height(h + 1, 30.0):
+            failures.append(
+                f"no recovery after unsilence: {run.heights()}"
+            )
+        return failures
+
+    return Scenario(
+        name="silence_watchdog",
+        description=">1/3 voting power silenced: the liveness watchdog must "
+                    "name the silenced validators' cumulative power, then "
+                    "the net recovers when they return",
+        seed=7,
+        timeout_s=90.0,
+        drive=drive,
+    )
+
+
+SCENARIOS: Dict[str, Callable[[], Scenario]] = {
+    "baseline_determinism": baseline_determinism,
+    "partition_heal": partition_heal,
+    "storm": storm,
+    "clock_skew": clock_skew,
+    "churn": churn,
+    "equivocation": equivocation,
+    "silence_watchdog": silence_watchdog,
+}

@@ -437,6 +437,45 @@ class TestTraceMerge:
         assert tm.compute_skews([d0, d1]) == [0, 0]
         assert tm.anchor_spread([d0, d1], [0, 0]) == {}
 
+    def test_alignment_warnings_flag_degenerate_overlap(self, tm):
+        base = [(1, "AA", 1_000_000_000), (2, "BB", 2_000_000_000)]
+        # healthy: >= 2 shared anchors, no warnings
+        assert tm.alignment_warnings(
+            [_mk_dump("n0", base), _mk_dump("n1", base, skew_ns=5_000)]
+        ) == []
+        # single dump: nothing to align, not a problem
+        assert tm.alignment_warnings([_mk_dump("n0", base)]) == []
+        # no dumps at all
+        assert tm.alignment_warnings([]) == ["nothing to merge: no flight dumps"]
+        # disjoint heights: no shared anchor, must be called out by name
+        warns = tm.alignment_warnings([
+            _mk_dump("n0", [(1, "AA", 1_000_000_000)]),
+            _mk_dump("n1", [(9, "ZZ", 9_000_000_000)]),
+        ])
+        assert len(warns) == 1
+        assert "n1" in warns[0] and "no commit anchors shared" in warns[0]
+        # exactly one shared anchor: median is a single sample
+        warns = tm.alignment_warnings([
+            _mk_dump("n0", base),
+            _mk_dump("n1", base[:1], skew_ns=5_000),
+        ])
+        assert len(warns) == 1
+        assert "only 1 commit anchor" in warns[0]
+        # reference itself committed nothing: alignment impossible anywhere
+        warns = tm.alignment_warnings([
+            _mk_dump("n0", []), _mk_dump("n1", base),
+        ])
+        assert any("reference node n0" in w for w in warns)
+
+    def test_merge_carries_alignment_warnings(self, tm):
+        d0 = _mk_dump("n0", [(1, "AA", 1_000_000_000)])
+        d1 = _mk_dump("n1", [(9, "ZZ", 9_000_000_000)])
+        merged = tm.merge([d0, d1])
+        assert any(
+            "no commit anchors shared" in w
+            for w in merged["otherData"]["alignment_warnings"]
+        )
+
     def test_differing_hash_is_not_an_anchor(self, tm):
         # same height, different hash (e.g. dump raced a re-org) must NOT
         # align clocks on a non-shared instant

@@ -1,0 +1,281 @@
+"""Chaos/Byzantine simulation harness tests (`tendermint_tpu/sim`).
+
+Fast tier: fabric determinism/replay, fault controls, clock injection, the
+equivocating signer, the evidence reactor's lagging-peer hold-back, and the
+end-to-end evidence pipeline (double-sign → DuplicateVoteEvidence → gossip
+→ block inclusion → committed + pruned from pending).
+
+Slow tier (``-m slow``): the full named-scenario matrix and the run-to-run
+commit-hash determinism check — the same coverage `make chaos-smoke` runs
+as a script.
+"""
+
+import time
+
+import pytest
+
+from tendermint_tpu.evidence.reactor import (
+    EvidenceReactor,
+    decode_evidence_list,
+    encode_evidence_list,
+)
+from tendermint_tpu.libs.clist import CList
+from tendermint_tpu.sim import SCENARIOS, round0_clean_top, run_scenario
+from tendermint_tpu.sim.byzantine import EquivocatingPV, _fabricated_block_id
+from tendermint_tpu.sim.clock import SimClock
+from tendermint_tpu.sim.simnet import LinkPolicy, SimNet, _decide
+
+# ---------------------------------------------------------------------------
+# fabric: seeded decisions, replay, fault controls
+# ---------------------------------------------------------------------------
+
+
+class _SinkSwitch:
+    """Registerable stand-in that records deliveries."""
+
+    def __init__(self, node_id):
+        self.node_id = node_id
+        self.got = []
+
+    def connect(self, peer_id):
+        pass
+
+    def disconnect(self, peer_id, reason=None):
+        pass
+
+    def deliver(self, chan_id, src_id, msg):
+        self.got.append((chan_id, src_id, msg))
+
+
+class TestSimNet:
+    def test_decisions_are_pure_functions_of_seed(self):
+        pol = LinkPolicy(delay_s=0.001, jitter_s=0.01, drop=0.3,
+                         duplicate=0.2, reorder=0.4)
+        a = _decide(pol, 42, "sim0", "sim1", 7, 0x20, 100)
+        b = _decide(pol, 42, "sim0", "sim1", 7, 0x20, 100)
+        assert a == b
+        # any coordinate change re-keys the rng
+        assert _decide(pol, 43, "sim0", "sim1", 7, 0x20, 100) != a
+
+    def test_replay_schedule_detects_tampering(self):
+        net = SimNet(seed=9)
+        s0, s1 = _SinkSwitch("sim0"), _SinkSwitch("sim1")
+        net.register(s0)
+        net.register(s1)
+        net.set_policy(None, None, LinkPolicy(drop=0.5, jitter_s=0.001))
+        net.start()
+        try:
+            for i in range(50):
+                net.send("sim0", "sim1", 0x20, b"m%d" % i)
+            assert len(net.schedule_log) == 50
+            assert net.replay_schedule() == []
+            net.schedule_log[17].dropped = not net.schedule_log[17].dropped
+            assert net.replay_schedule() == [17]
+        finally:
+            net.stop()
+
+    def test_clean_links_do_not_grow_the_log(self):
+        net = SimNet(seed=1)
+        s0, s1 = _SinkSwitch("sim0"), _SinkSwitch("sim1")
+        net.register(s0)
+        net.register(s1)
+        net.start()
+        try:
+            for _ in range(20):
+                net.send("sim0", "sim1", 0x20, b"x")
+            assert net.schedule_log == []
+            deadline = time.monotonic() + 2.0
+            while len(s1.got) < 20 and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert len(s1.got) == 20
+        finally:
+            net.stop()
+
+    def test_partition_and_silence_drop_traffic(self):
+        net = SimNet(seed=2)
+        switches = [_SinkSwitch(f"sim{i}") for i in range(4)]
+        for s in switches:
+            net.register(s)
+        net.start()
+        try:
+            net.set_partition([{"sim0", "sim1"}, {"sim2", "sim3"}])
+            net.send("sim0", "sim2", 0x20, b"cross")
+            net.send("sim0", "sim1", 0x20, b"within")
+            assert net.stats["partition_dropped"] == 1
+            net.heal_partition()
+
+            net.silence({"sim3"})
+            net.send("sim3", "sim0", 0x20, b"void")
+            assert net.stats["silence_dropped"] == 1
+            net.unsilence()
+            deadline = time.monotonic() + 2.0
+            while not switches[1].got and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert [m for _, _, m in switches[1].got] == [b"within"]
+            assert all(m != b"cross" for _, _, m in switches[2].got)
+        finally:
+            net.stop()
+
+
+class TestSimClock:
+    def test_skew_shifts_wall_clock(self):
+        c = SimClock(skew_ns=5_000_000_000)
+        assert abs(c() - time.time_ns() - 5_000_000_000) < 1_000_000_000
+
+    def test_freeze_pins_the_clock(self):
+        c = SimClock(skew_ns=7, frozen_at_ns=1_000)
+        assert c() == 1_007
+        assert c.now_ns() == 1_007
+        c.set_skew(0)
+        assert c() == 1_000
+
+
+class TestEquivocatingPV:
+    def test_fabricated_block_id_is_deterministic(self):
+        a = _fabricated_block_id(5, 0, 1)
+        assert a == _fabricated_block_id(5, 0, 1)
+        assert a != _fabricated_block_id(5, 0, 2)
+        assert len(a.hash) == 32
+
+
+# ---------------------------------------------------------------------------
+# evidence reactor: lagging/unknown peer height holds evidence back
+# ---------------------------------------------------------------------------
+
+
+class _FakeEvidence:
+    def __init__(self, height):
+        self.height = height
+
+    def marshal(self):
+        return b"ev@%d" % self.height
+
+
+class _FakeEvPool:
+    def __init__(self, evs):
+        self.evidence_list = CList()
+        for ev in evs:
+            self.evidence_list.push_back(ev)
+
+
+class _RecordingPeer:
+    def __init__(self, peer_id="peerA"):
+        self.id = peer_id
+        self.is_running = True
+        self.sent = []
+
+    def send(self, chan_id, payload):
+        self.sent.append((chan_id, payload))
+        return True
+
+
+def _wait(predicate, timeout=3.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(0.01)
+    return False
+
+
+class TestEvidenceHoldBack:
+    def _start(self, reactor, peer):
+        reactor.start()
+        reactor.add_peer(peer)
+
+    def test_unknown_peer_height_holds_evidence_back(self):
+        """Regression: a wired height lookup that returns None (peer still
+        handshaking / hasn't announced state) must NOT mean send-now."""
+        heights = {}
+        reactor = EvidenceReactor(
+            _FakeEvPool([_FakeEvidence(height=5)]),
+            peer_height_lookup=lambda pid: heights.get(pid),
+        )
+        peer = _RecordingPeer()
+        self._start(reactor, peer)
+        try:
+            time.sleep(0.4)
+            assert peer.sent == [], "evidence leaked to unknown-height peer"
+            heights[peer.id] = 3  # lagging: still below ev.height
+            time.sleep(0.4)
+            assert peer.sent == [], "evidence leaked to lagging peer"
+            heights[peer.id] = 5  # caught up
+            assert _wait(lambda: len(peer.sent) == 1)
+        finally:
+            reactor.stop()
+
+    def test_standalone_reactor_broadcasts_eagerly(self):
+        # no lookup wired at all: legacy standalone behavior is unchanged
+        reactor = EvidenceReactor(
+            _FakeEvPool([_FakeEvidence(height=5)]), peer_height_lookup=None
+        )
+        peer = _RecordingPeer()
+        self._start(reactor, peer)
+        try:
+            assert _wait(lambda: len(peer.sent) == 1)
+        finally:
+            reactor.stop()
+
+    def test_encode_decode_roundtrip(self):
+        payload = encode_evidence_list([])
+        assert decode_evidence_list(payload) == []
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: the evidence pipeline under a real equivocating validator
+# ---------------------------------------------------------------------------
+
+
+class TestEvidenceEndToEnd:
+    def test_equivocation_to_committed_evidence(self):
+        """Double-sign → honest nodes mint DuplicateVoteEvidence → gossip →
+        proposer includes it in a block → committed on ALL nodes → marked
+        committed in every pool → gone from pending (pruned)."""
+        result = run_scenario(SCENARIOS["equivocation"]())
+        assert result.ok, f"seed={result.seed} failures={result.failures}"
+        # every node's chain carries the evidence in some committed block
+        assert result.heights and min(result.heights) >= 2
+        assert result.fault_summary.get("sent", 0) > 0
+
+
+# ---------------------------------------------------------------------------
+# slow tier: the full matrix + determinism, same coverage as chaos-smoke
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+class TestScenarioMatrix:
+    @pytest.mark.parametrize("name", sorted(SCENARIOS))
+    def test_scenario(self, name):
+        result = run_scenario(SCENARIOS[name]())
+        assert result.ok, f"seed={result.seed} failures={result.failures}"
+
+    def test_same_seed_same_chain(self):
+        """Same seed ⇒ identical chain, for as long as every commit forms
+        at round 0.  A round > 0 commit means a real-time timeout fired
+        (host under load) and proposer rotation may legitimately diverge,
+        so runs perturbed that way are retried rather than failed."""
+        target = SCENARIOS["baseline_determinism"]().target_height
+        top = 0
+        for attempt in range(3):
+            r1 = run_scenario(SCENARIOS["baseline_determinism"]())
+            r2 = run_scenario(SCENARIOS["baseline_determinism"]())
+            # safety/replay problems are bugs; only liveness misses (pure
+            # wall-clock) qualify for a retry
+            hard = [f for f in r1.failures + r2.failures
+                    if not f.startswith("liveness")]
+            assert not hard, hard
+            top = min(round0_clean_top(r1), round0_clean_top(r2))
+            if r1.ok and r2.ok and top >= target:
+                break
+        else:
+            pytest.skip(
+                f"host too loaded to evaluate determinism: round-0-clean "
+                f"prefix only reached h={top} (< {target}) in 3 attempts"
+            )
+        for node in range(len(r1.commit_hashes)):
+            for h in range(1, top + 1):
+                assert r1.commit_hashes[node][h] == r2.commit_hashes[node][h], (
+                    f"node {node} height {h} hash diverged across identical "
+                    f"seeds"
+                )

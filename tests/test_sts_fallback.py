@@ -1,0 +1,257 @@
+"""RFC-vector validation for the pure-Python STS fallback primitives
+(crypto/sts_fallback.py): X25519 (RFC 7748), ChaCha20 / Poly1305 /
+ChaCha20-Poly1305 AEAD (RFC 8439), HKDF-SHA256 (RFC 5869) — plus the
+secret-connection handshake running end-to-end on the fallback classes
+regardless of whether the `cryptography` wheel is installed.
+"""
+
+import socket
+import threading
+from binascii import unhexlify as h
+
+import pytest
+
+from tendermint_tpu.crypto.keys import PrivKeyEd25519
+from tendermint_tpu.crypto.sts_fallback import (
+    HKDF,
+    ChaCha20Poly1305,
+    InvalidTag,
+    X25519PrivateKey,
+    X25519PublicKey,
+    chacha20_block,
+    hashes,
+    poly1305_mac,
+    x25519_scalarmult,
+)
+
+# ---------------------------------------------------------------------------
+# X25519 — RFC 7748 §5.2 and §6.1
+# ---------------------------------------------------------------------------
+
+
+class TestX25519:
+    def test_rfc7748_vector_1(self):
+        out = x25519_scalarmult(
+            h("a546e36bf0527c9d3b16154b82465edd62144c0ac1fc5a18506a2244ba449ac4"),
+            h("e6db6867583030db3594c1a424b15f7c726624ec26b3353b10a903a6d0ab1c4c"),
+        )
+        assert out == h(
+            "c3da55379de9c6908e94ea4df28d084f32eccf03491c71f754b4075577a28552"
+        )
+
+    def test_rfc7748_vector_2(self):
+        out = x25519_scalarmult(
+            h("4b66e9d4d1b4673c5ad22691957d6af5c11b6421e0ea01d42ca4169e7918ba0d"),
+            h("e5210f12786811d3f4b7959d0538ae2c31dbe7106fc03c3efc4cd549c715a493"),
+        )
+        assert out == h(
+            "95cbde9476e8907d7aade45cb4b873f88b595a68799fa152e6f8f7647aac7957"
+        )
+
+    def test_rfc7748_diffie_hellman(self):
+        apriv = h("77076d0a7318a57d3c16c17251b26645df4c2f87ebc0992ab177fba51db92c2a")
+        bpriv = h("5dab087e624a8a4b79e17f8b83800ee66f3bb1292618b6fd1c2f8b27ff88e0eb")
+        a, b = X25519PrivateKey(apriv), X25519PrivateKey(bpriv)
+        assert a.public_key().public_bytes_raw() == h(
+            "8520f0098930a754748b7ddcb43ef75a0dbf3a0d26381af4eba4a98eaa9b4e6a"
+        )
+        assert b.public_key().public_bytes_raw() == h(
+            "de9edb7d7b7dc1b4d35b61c2ece435373f8343c85b78674dadfc7e146f882b4f"
+        )
+        shared = h("4a5d9d5ba4ce2de1728e3bf480350f25e07e21c947d19e3376f09b3c1e161742")
+        assert a.exchange(b.public_key()) == shared
+        assert b.exchange(a.public_key()) == shared
+
+    def test_generated_keys_agree(self):
+        a, b = X25519PrivateKey.generate(), X25519PrivateKey.generate()
+        assert a.exchange(b.public_key()) == b.exchange(a.public_key())
+
+    def test_small_order_point_rejected(self):
+        # the all-zero u-coordinate is a small-order point: the exchange
+        # must refuse the resulting all-zero secret (contributory check)
+        with pytest.raises(ValueError):
+            X25519PrivateKey.generate().exchange(
+                X25519PublicKey.from_public_bytes(b"\x00" * 32)
+            )
+
+    def test_high_bit_of_u_is_masked(self):
+        # RFC 7748 §5: implementations MUST mask bit 255 of the incoming u
+        k = h("a546e36bf0527c9d3b16154b82465edd62144c0ac1fc5a18506a2244ba449ac4")
+        u = bytearray(
+            h("e6db6867583030db3594c1a424b15f7c726624ec26b3353b10a903a6d0ab1c4c")
+        )
+        u[31] |= 0x80
+        assert x25519_scalarmult(k, bytes(u)) == x25519_scalarmult(k, bytes(u[:31]) + bytes([u[31] & 0x7F]))
+
+    def test_bad_lengths_rejected(self):
+        with pytest.raises(ValueError):
+            x25519_scalarmult(b"\x01" * 31, b"\x09" + b"\x00" * 31)
+        with pytest.raises(ValueError):
+            x25519_scalarmult(b"\x01" * 32, b"\x09" * 33)
+        with pytest.raises(ValueError):
+            X25519PublicKey.from_public_bytes(b"\x00" * 16)
+
+
+# ---------------------------------------------------------------------------
+# ChaCha20 / Poly1305 / AEAD — RFC 8439 §2.3.2, §2.5.2, §2.8.2, A.5
+# ---------------------------------------------------------------------------
+
+
+class TestChaCha20Poly1305:
+    def test_rfc8439_chacha20_block(self):
+        blk = chacha20_block(
+            bytes(range(32)), 1, h("000000090000004a00000000")
+        )
+        assert blk == h(
+            "10f1e7e4d13b5915500fdd1fa32071c4c7d1f4c733c068030422aa9ac3d46c4e"
+            "d2826446079faa0914c2d705d98b02a2b5129cd1de164eb9cbd083e8a2503c4e"
+        )
+
+    def test_rfc8439_poly1305(self):
+        tag = poly1305_mac(
+            h("85d6be7857556d337f4452fe42d506a80103808afb0db2fd4abff6af4149f51b"),
+            b"Cryptographic Forum Research Group",
+        )
+        assert tag == h("a8061dc1305136c6c22b8baf0c0127a9")
+
+    _KEY = h("808182838485868788898a8b8c8d8e8f909192939495969798999a9b9c9d9e9f")
+    _NONCE = h("070000004041424344454647")
+    _AAD = h("50515253c0c1c2c3c4c5c6c7")
+    _PT = (
+        b"Ladies and Gentlemen of the class of '99: If I could offer you "
+        b"only one tip for the future, sunscreen would be it."
+    )
+    _CT_AND_TAG = h(
+        "d31a8d34648e60db7b86afbc53ef7ec2a4aded51296e08fea9e2b5a736ee62d6"
+        "3dbea45e8ca9671282fafb69da92728b1a71de0a9e060b2905d6a5b67ecd3b36"
+        "92ddbd7f2d778b8c9803aee328091b58fab324e4fad675945585808b4831d7bc"
+        "3ff4def08e4b7a9de576d26586cec64b6116"
+        "1ae10b594f09e26a7e902ecbd0600691"
+    )
+
+    def test_rfc8439_aead_seal(self):
+        aead = ChaCha20Poly1305(self._KEY)
+        assert aead.encrypt(self._NONCE, self._PT, self._AAD) == self._CT_AND_TAG
+
+    def test_rfc8439_aead_open(self):
+        aead = ChaCha20Poly1305(self._KEY)
+        assert aead.decrypt(self._NONCE, self._CT_AND_TAG, self._AAD) == self._PT
+
+    def test_tampered_ciphertext_rejected(self):
+        aead = ChaCha20Poly1305(self._KEY)
+        bad = bytearray(self._CT_AND_TAG)
+        bad[3] ^= 0x01
+        with pytest.raises(InvalidTag):
+            aead.decrypt(self._NONCE, bytes(bad), self._AAD)
+
+    def test_tampered_aad_rejected(self):
+        aead = ChaCha20Poly1305(self._KEY)
+        with pytest.raises(InvalidTag):
+            aead.decrypt(self._NONCE, self._CT_AND_TAG, b"not the aad")
+
+    def test_truncated_input_rejected(self):
+        with pytest.raises(InvalidTag):
+            ChaCha20Poly1305(self._KEY).decrypt(self._NONCE, b"\x00" * 8, None)
+
+    def test_empty_plaintext_roundtrip(self):
+        aead = ChaCha20Poly1305(self._KEY)
+        sealed = aead.encrypt(self._NONCE, b"", None)
+        assert len(sealed) == 16
+        assert aead.decrypt(self._NONCE, sealed, None) == b""
+
+
+# ---------------------------------------------------------------------------
+# HKDF-SHA256 — RFC 5869 appendix A
+# ---------------------------------------------------------------------------
+
+
+class TestHKDF:
+    def test_rfc5869_case_1(self):
+        okm = HKDF(
+            algorithm=hashes.SHA256(),
+            length=42,
+            salt=h("000102030405060708090a0b0c"),
+            info=h("f0f1f2f3f4f5f6f7f8f9"),
+        ).derive(h("0b" * 22))
+        assert okm == h(
+            "3cb25f25faacd57a90434f64d0362f2a2d2d0a90cf1a5a4c5db02d56ecc4c5bf"
+            "34007208d5b887185865"
+        )
+
+    def test_rfc5869_case_2_long_inputs(self):
+        okm = HKDF(
+            algorithm=hashes.SHA256(),
+            length=82,
+            salt=h("".join(f"{i:02x}" for i in range(0x60, 0xB0))),
+            info=h("".join(f"{i:02x}" for i in range(0xB0, 0x100))),
+        ).derive(h("".join(f"{i:02x}" for i in range(0x00, 0x50))))
+        assert okm == h(
+            "b11e398dc80327a1c8e7f78c596a49344f012eda2d4efad8a050cc4c19afa97c"
+            "59045a99cac7827271cb41c65e590e09da3275600c2f09b8367793a9aca3db71"
+            "cc30c58179ec3e87c14c01d5c1f3434f1d87"
+        )
+
+    def test_rfc5869_case_3_no_salt_no_info(self):
+        okm = HKDF(
+            algorithm=hashes.SHA256(), length=42, salt=None, info=b""
+        ).derive(h("0b" * 22))
+        assert okm == h(
+            "8da4e775a563c18f715f802a063c5a31b8a11f5c5ee1879ec3454e5f3c738d2d"
+            "9d201395faa4b61a96c8"
+        )
+
+    def test_single_use(self):
+        kdf = HKDF(algorithm=hashes.SHA256(), length=32, salt=None, info=b"x")
+        kdf.derive(b"ikm")
+        with pytest.raises(RuntimeError):
+            kdf.derive(b"ikm")
+
+
+# ---------------------------------------------------------------------------
+# The fallback carries the real STS handshake end-to-end
+# ---------------------------------------------------------------------------
+
+
+class TestSecretConnectionOnFallback:
+    def test_handshake_and_traffic(self, monkeypatch):
+        # force the fallback classes into secret_connection regardless of
+        # whether `cryptography` is importable in this environment
+        from tendermint_tpu.crypto import sts_fallback
+        from tendermint_tpu.p2p.conn import secret_connection as sc
+
+        monkeypatch.setattr(sc, "X25519PrivateKey", sts_fallback.X25519PrivateKey)
+        monkeypatch.setattr(sc, "X25519PublicKey", sts_fallback.X25519PublicKey)
+        monkeypatch.setattr(sc, "ChaCha20Poly1305", sts_fallback.ChaCha20Poly1305)
+        monkeypatch.setattr(sc, "HKDF", sts_fallback.HKDF)
+        monkeypatch.setattr(sc, "hashes", sts_fallback.hashes)
+
+        s1, s2 = socket.socketpair()
+        k1, k2 = PrivKeyEd25519.generate(), PrivKeyEd25519.generate()
+        out, err = [None, None], [None, None]
+
+        def go(i, sock, key):
+            try:
+                out[i] = sc.SecretConnection(sc.RawConn(sock), key)
+            except Exception as e:  # pragma: no cover - assertion below
+                err[i] = e
+
+        threads = [
+            threading.Thread(target=go, args=(0, s1, k1)),
+            threading.Thread(target=go, args=(1, s2, k2)),
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(10)
+        assert err == [None, None], err
+
+        assert out[0].remote_pubkey.bytes() == k2.pub_key().bytes()
+        assert out[1].remote_pubkey.bytes() == k1.pub_key().bytes()
+
+        blob = bytes(range(256)) * 8  # spans multiple 1024-byte frames
+        out[0].write(blob)
+        assert out[1].read_exactly(len(blob)) == blob
+        out[1].write(b"pong")
+        assert out[0].read_exactly(4) == b"pong"
+        out[0].close()
+        out[1].close()
