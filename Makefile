@@ -3,8 +3,8 @@
 PYTHON ?= python
 
 .PHONY: test test_slow test_sanitizers bench bench-local bench_fastsync \
-        planner-bench bench_secp bench_multisig metrics-lint bench-check \
-        statesync-smoke flight-smoke chaos-smoke localnet-start \
+        planner-bench bench_secp bench_multisig mempool-bench metrics-lint \
+        bench-check statesync-smoke flight-smoke chaos-smoke localnet-start \
         localnet-stop build-docker-localnode
 
 test:
@@ -37,6 +37,11 @@ bench_secp:
 
 bench_multisig:
 	$(PYTHON) scripts/bench_multisig.py 1000 3 5
+
+# mempool ingestion: serial vs micro-batched CheckTx, QoS decision rate,
+# recheck throughput; headline metric is mempool_checktx_per_s
+mempool-bench:
+	JAX_PLATFORMS=cpu $(PYTHON) scripts/bench_mempool.py $(ARGS)
 
 # strict text-format v0.0.4 self-check of Registry.expose_text(); pass files
 # to lint scrape snapshots: make metrics-lint ARGS="/tmp/m.prom"

@@ -52,6 +52,9 @@ PROBE_TIMEOUT_S = 45
 DEVICE_WALL_TIMEOUT_S = 420  # child: build + compile + upload + 6 verifies
 DEVICE_P50_TIMEOUT_S = 240  # additional budget for the device-resident stage
 FASTSYNC_TIMEOUT_S = 300
+MEMPOOL_TIMEOUT_S = 120
+MEMPOOL_TXS = 20_000
+MEMPOOL_BATCH = 64
 
 FASTSYNC_BLOCKS = 512
 FASTSYNC_VALS = 64
@@ -278,6 +281,41 @@ def _run_fastsync(alive: bool):
     return None
 
 
+def _run_mempool():
+    """Mempool ingestion rate via scripts/bench_mempool.py — pure host
+    (CPython) work, so it runs the same with or without the chip."""
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    try:
+        res = subprocess.run(
+            [
+                sys.executable,
+                os.path.join(_REPO, "scripts", "bench_mempool.py"),
+                str(MEMPOOL_TXS),
+                str(MEMPOOL_BATCH),
+            ],
+            timeout=MEMPOOL_TIMEOUT_S,
+            capture_output=True,
+            text=True,
+            env=env,
+            cwd=_REPO,
+        )
+    except subprocess.TimeoutExpired:
+        print("# mempool stage: deadline exceeded", file=sys.stderr)
+        return None
+    for line in reversed(res.stdout.splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                parsed = json.loads(line)
+            except ValueError:
+                continue
+            if "mempool_checktx_per_s" in parsed:
+                return parsed
+    print(f"# mempool stage failed rc={res.returncode}", file=sys.stderr)
+    return None
+
+
 def main():
     from tendermint_tpu.crypto import ed25519 as ed
     from tendermint_tpu.crypto.batch import HostBatchVerifier
@@ -336,6 +374,13 @@ def main():
         if fastsync is not None:
             result["fastsync_blocks_per_s"] = fastsync.get("value")
             result["fastsync_vs_baseline"] = fastsync.get("vs_baseline")
+            print(json.dumps(result), flush=True)
+        mempool = _run_mempool()
+        if mempool is not None:
+            result["mempool_checktx_per_s"] = mempool.get(
+                "mempool_checktx_per_s"
+            )
+            result["mempool_checktx_vs_serial"] = mempool.get("vs_serial")
             print(json.dumps(result), flush=True)
     return 0
 

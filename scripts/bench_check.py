@@ -34,6 +34,13 @@ from dataclasses import dataclass
 from typing import List, Optional
 
 DEFAULT_METRIC = "fastsync_blocks_per_s"
+# default gate: the historical fastsync headline plus mempool ingestion.
+# Rounds predating a metric are "reported and skipped", so extending this
+# list never fails old ledgers retroactively.
+DEFAULT_METRICS = [
+    DEFAULT_METRIC,
+    "mempool_checktx_per_s:0.25:higher",
+]
 DEFAULT_THRESHOLD = 0.20
 
 
@@ -162,7 +169,7 @@ def main(argv=None) -> int:
         os.path.dirname(os.path.abspath(__file__))
     ), help="directory holding BENCH_r*.json")
     args = p.parse_args(argv)
-    raw = args.metric or [DEFAULT_METRIC]
+    raw = args.metric or list(DEFAULT_METRICS)
     try:
         specs = [MetricSpec.parse(s, args.threshold) for s in raw]
     except ValueError as e:

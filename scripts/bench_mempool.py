@@ -1,0 +1,131 @@
+"""Mempool ingestion benchmark: CheckTx admission throughput (serial vs
+micro-batched app-conn windows), QoS admission-decision rate, and post-commit
+recheck throughput.
+
+Emits one JSON line per stage and a final combined line whose headline is
+``mempool_checktx_per_s`` — the metric `make bench-check` gates on.
+
+Usage: python scripts/bench_mempool.py [N_TXS] [BATCH] [--metrics-out PATH]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from scripts._bench_metrics import pop_metrics_out  # noqa: E402
+
+from tendermint_tpu.abci.examples.kvstore import PriorityKVStoreApp  # noqa: E402
+from tendermint_tpu.config.config import MempoolConfig  # noqa: E402
+from tendermint_tpu.libs.metrics import NodeMetrics  # noqa: E402
+from tendermint_tpu.mempool.mempool import Mempool  # noqa: E402
+from tendermint_tpu.mempool.qos import MempoolQoS  # noqa: E402
+from tendermint_tpu.proxy.app_conn import (  # noqa: E402
+    LocalClientCreator,
+    MultiAppConn,
+)
+
+N_TXS = 20_000
+BATCH = 64
+QOS_DECISIONS = 200_000
+
+
+def make_mempool(n: int, metrics=None, **kw) -> Mempool:
+    conn = MultiAppConn(LocalClientCreator(PriorityKVStoreApp()))
+    conn.start()
+    return Mempool(
+        conn.mempool, size=2 * n, cache_size=2 * n, metrics=metrics, **kw
+    )
+
+
+def checktx_rate(n: int, tag: bytes, metrics=None, **kw) -> float:
+    mp = make_mempool(n, metrics=metrics, **kw)
+    txs = [b"pri%d:%s%07d=v" % (i % 2048, tag, i) for i in range(n)]
+    t0 = time.perf_counter()
+    for tx in txs:
+        mp.check_tx(tx)
+    mp.flush_app_conn()
+    dt = time.perf_counter() - t0
+    assert mp.size() == n, f"admitted {mp.size()}/{n}"
+    return n / dt
+
+
+def qos_admit_rate(n: int) -> float:
+    cfg = MempoolConfig(
+        qos_peer_tx_rate=float(n), qos_peer_tx_burst=float(n),
+        qos_peer_byte_rate=float(n) * 64, qos_peer_byte_burst=float(n) * 64,
+        qos_global_tx_rate=float(n), qos_global_tx_burst=float(n),
+    )
+    q = MempoolQoS(cfg)
+    peers = [f"peer{i}" for i in range(8)]
+    t0 = time.perf_counter()
+    for i in range(n):
+        q.admit(peers[i & 7], 42)
+    return n / (time.perf_counter() - t0)
+
+
+def recheck_rate(n: int, window: int) -> float:
+    mp = make_mempool(n, recheck=True, recheck_batch=window)
+    for i in range(n):
+        mp.check_tx(b"r%07d=v" % i)
+    mp.flush_app_conn()
+    t0 = time.perf_counter()
+    mp.lock()
+    try:
+        mp.update(2, [])
+    finally:
+        mp.unlock()
+    dt = time.perf_counter() - t0
+    assert mp.size() == n
+    return n / dt
+
+
+def main() -> int:
+    metrics_out = pop_metrics_out()
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else N_TXS
+    batch = int(sys.argv[2]) if len(sys.argv) > 2 else BATCH
+
+    metrics = NodeMetrics()
+    serial = checktx_rate(n, b"s", metrics=metrics, checktx_batch=1)
+    print(json.dumps({"stage": "checktx_serial", "tx_per_s": round(serial, 1)}),
+          flush=True)
+    batched = checktx_rate(
+        n, b"b", metrics=metrics,
+        lane_bounds=(1, 1024), checktx_batch=batch, checktx_batch_wait=0.05,
+    )
+    print(json.dumps({"stage": "checktx_batched", "batch": batch,
+                      "tx_per_s": round(batched, 1)}), flush=True)
+    qos = qos_admit_rate(QOS_DECISIONS)
+    print(json.dumps({"stage": "qos_admit", "decisions_per_s": round(qos, 1)}),
+          flush=True)
+    recheck = recheck_rate(n, window=max(1, batch) * 4)
+    print(json.dumps({"stage": "recheck", "tx_per_s": round(recheck, 1)}),
+          flush=True)
+
+    if metrics_out:
+        with open(metrics_out, "w") as f:
+            f.write(metrics.registry.expose_text())
+        print(f"# metrics snapshot -> {metrics_out}", file=sys.stderr)
+
+    # headline last: the ledger's parser keeps the final JSON line
+    print(json.dumps({
+        "metric": "mempool_checktx_per_s",
+        "value": round(batched, 1),
+        "unit": "tx/s",
+        "mempool_checktx_per_s": round(batched, 1),
+        "mempool_checktx_serial_per_s": round(serial, 1),
+        "mempool_qos_admit_per_s": round(qos, 1),
+        "mempool_recheck_per_s": round(recheck, 1),
+        "batch": batch,
+        "n_txs": n,
+        "vs_serial": round(batched / serial, 2),
+    }), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

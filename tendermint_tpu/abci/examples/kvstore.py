@@ -93,6 +93,31 @@ class KVStoreApp(abci.Application):
         return abci.ResponseQuery(code=1, log=f"unknown path {req.path}")
 
 
+PRIORITY_TX_PREFIX = b"pri"
+
+
+class PriorityKVStoreApp(KVStoreApp):
+    """KVStore whose CheckTx reports a mempool priority: a tx shaped
+    ``pri<N>:key=value`` carries priority N (any other tx is priority 0).
+    Exercises the mempool's priority lanes end to end — the prefix is the
+    stand-in for a real app's gas-price computation."""
+
+    @staticmethod
+    def tx_priority(tx: bytes) -> int:
+        if tx.startswith(PRIORITY_TX_PREFIX):
+            head, _, _ = tx.partition(b":")
+            try:
+                return int(head[len(PRIORITY_TX_PREFIX):])
+            except ValueError:
+                return 0
+        return 0
+
+    def check_tx(self, req: abci.RequestCheckTx) -> abci.ResponseCheckTx:
+        return abci.ResponseCheckTx(
+            code=abci.CODE_TYPE_OK, priority=self.tx_priority(req.tx)
+        )
+
+
 class PersistentKVStoreApp(KVStoreApp):
     """KVStore + validator-set changes + height persistence
     (ref persistent_kvstore.go:199: InitChain seeds validators, DeliverTx of

@@ -295,6 +295,10 @@ class ResponseCheckTx:
     gas_wanted: int = 0
     gas_used: int = 0
     tags: List[KVPair] = field(default_factory=list)
+    # mempool ordering hint (CometBFT's priority mempool field): higher
+    # values ride higher lanes; apps that leave it 0 fall back to
+    # gas_wanted as a gas-price proxy
+    priority: int = 0
 
     @property
     def is_ok(self) -> bool:

@@ -55,6 +55,10 @@ class RPCConfig:
     grpc_max_open_connections: int = 900
     unsafe: bool = False
     max_open_connections: int = 900
+    # load-shedding budget for broadcast_tx_* : at most this many in-flight
+    # submissions across async+sync+commit before new ones are rejected
+    # with a fast mempool-overloaded error. 0 = unbounded (old behavior).
+    broadcast_max_in_flight: int = 256
 
 
 @dataclass
@@ -91,6 +95,39 @@ class MempoolConfig:
     wal_path: str = ""
     size: int = 5000
     cache_size: int = 10000
+    # -- per-peer QoS (mempool/qos.py). Rates are tokens/s with a burst
+    # allowance; rate <= 0 disables that bucket. Defaults are generous:
+    # honest gossip never notices them, a flooder does.
+    qos_enabled: bool = True
+    qos_peer_tx_rate: float = 1000.0
+    qos_peer_tx_burst: float = 2000.0
+    qos_peer_byte_rate: float = float(1 << 20)  # 1 MiB/s
+    qos_peer_byte_burst: float = float(2 << 20)
+    qos_global_tx_rate: float = 0.0  # aggregate cap across peers; 0 = off
+    qos_global_tx_burst: float = 0.0  # 0 = 2x rate
+    # repeat-offender demotion: after `mute_after` violations the peer is
+    # muted for mute_base_s * 2^offenses (capped at mute_max_s); a clean
+    # stretch of forgive_s after a mute expires resets the offense count
+    qos_mute_after: int = 50
+    qos_mute_base_s: float = 1.0
+    qos_mute_max_s: float = 60.0
+    qos_forgive_s: float = 30.0
+    # fairness under a contended global bucket: peers above
+    # slack * (window grants / n_peers) shed first; under-share peers may
+    # overdraft up to fair_reserve tokens (0 = global burst)
+    qos_fair_window_s: float = 1.0
+    qos_fair_slack: float = 1.5
+    qos_fair_reserve: float = 0.0
+    # -- priority lanes: ascending priority thresholds; a tx with
+    # priority >= lane_bounds[i] rides lane i+1. () = single lane
+    # (reference behavior: full mempool rejects instead of evicting).
+    lane_bounds: tuple = (1, 1024)
+    # -- micro-batching: coalesce up to `checktx_batch` CheckTx submissions
+    # into one app-conn flush window (1 = flush per tx, the reference
+    # behavior); recheck_batch chunks post-commit rechecks (0 = one window
+    # for the whole round).
+    checktx_batch: int = 1
+    recheck_batch: int = 0
 
 
 @dataclass

@@ -569,6 +569,41 @@ class NodeMetrics:
         self.mempool_recheck_times = r.counter(
             "mempool_recheck_times", "Txs re-checked after a commit"
         )
+        # mempool QoS / admission control (mempool/qos.py)
+        self.mempool_qos_admitted_total = r.counter(
+            "mempool_qos_admitted_total",
+            "Peer txs admitted past the QoS layer",
+        )
+        self.mempool_qos_dropped_total = r.counter(
+            "mempool_qos_dropped_total",
+            "Peer txs dropped by the QoS layer",
+            label_names=("reason",),
+        )
+        self.mempool_qos_muted_peers = r.gauge(
+            "mempool_qos_muted_peers", "Peers currently muted by QoS"
+        )
+        self.mempool_qos_mutes_total = r.counter(
+            "mempool_qos_mutes_total", "Repeat-offender mutes issued"
+        )
+        self.mempool_qos_shed_total = r.counter(
+            "mempool_qos_shed_total",
+            "RPC broadcast_tx_* requests shed under overload",
+            label_names=("route",),
+        )
+        self.mempool_qos_evicted_total = r.counter(
+            "mempool_qos_evicted_total",
+            "Txs evicted from lower lanes to admit higher-priority txs",
+            label_names=("lane",),
+        )
+        self.mempool_lane_txs = r.gauge(
+            "mempool_lane_txs", "Unconfirmed txs per priority lane",
+            label_names=("lane",),
+        )
+        self.mempool_checktx_batch_size = r.histogram(
+            "mempool_checktx_batch_size",
+            "Txs coalesced per CheckTx/recheck app-conn window",
+            buckets=_SIZE_BUCKETS,
+        )
         # state
         self.block_processing_time = r.histogram(
             "state_block_processing_time", "ApplyBlock seconds",

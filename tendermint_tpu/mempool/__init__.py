@@ -1,0 +1,24 @@
+from tendermint_tpu.mempool.mempool import (
+    CODE_MEMPOOL_FULL,
+    Mempool,
+    MempoolError,
+    MempoolFullError,
+    MempoolTx,
+    TxCache,
+    TxInCacheError,
+)
+from tendermint_tpu.mempool.qos import MempoolQoS, TokenBucket
+from tendermint_tpu.mempool.reactor import MempoolReactor
+
+__all__ = [
+    "CODE_MEMPOOL_FULL",
+    "Mempool",
+    "MempoolError",
+    "MempoolFullError",
+    "MempoolQoS",
+    "MempoolReactor",
+    "MempoolTx",
+    "TokenBucket",
+    "TxCache",
+    "TxInCacheError",
+]

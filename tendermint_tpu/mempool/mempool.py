@@ -5,19 +5,42 @@ Structure mirrors the reference: a concurrent list of good txs feeding both
 block proposals (reap_max_bytes_max_gas) and peer gossip (clist iteration with
 wait-for-next), an LRU-ish cache of seen txs, recheck of survivors after every
 commit, and an optional WAL of accepted txs.
+
+On top of the reference shape this mempool adds the ingestion hardening from
+CometBFT's priority mempool era:
+
+* **priority lanes** — ``ResponseCheckTx.priority`` (falling back to
+  ``gas_wanted`` as a gas-price proxy) assigns each tx a lane via the
+  configured ``lane_bounds`` thresholds.  Reap serves higher lanes first
+  (FIFO within a lane); when the pool is full, a new tx evicts the oldest
+  tx from the lowest strictly-lower lane instead of being rejected.  With
+  no lanes configured (the default) behavior is exactly the reference:
+  full pool ⇒ synchronous ``MempoolFullError``.
+* **micro-batched CheckTx / batched recheck** — with ``checktx_batch > 1``
+  incoming submissions coalesce into one app-conn flush window;
+  ``recheck_batch > 0`` chunks the post-commit recheck the same way.  Pack
+  and flush timings land in the `libs/profile.py` dispatch ledger
+  (``mempool.checktx_batch`` / ``mempool.recheck_batch`` entries), and
+  ``batch_check_hook`` is the seam where planner-based batched signature
+  verification plugs in.
+* **recheck cursor resync** — a tx removed mid-recheck (committed while
+  responses were in flight) desynchronizes the cursor; the hash index is
+  used to resynchronize instead of silently corrupting the walk.
 """
 
 from __future__ import annotations
 
 import collections
 import threading
+import time
 from dataclasses import dataclass, field
-from typing import Callable, Dict, List, Optional
+from typing import Callable, Dict, List, Optional, Sequence
 
 from tendermint_tpu.abci import types as abci
 from tendermint_tpu.crypto.hashing import tmhash
 from tendermint_tpu.libs import trace
 from tendermint_tpu.libs.clist import CElement, CList
+from tendermint_tpu.libs.profile import get_profiler
 from tendermint_tpu.state.services import Mempool as MempoolIface
 
 
@@ -35,11 +58,19 @@ class MempoolFullError(MempoolError):
         super().__init__(f"mempool is full: {size} >= {max_size}")
 
 
+# nonzero ResponseCheckTx.code stamped on a tx rejected because the pool is
+# full and no lower-lane tx can be evicted for it (multi-lane configs defer
+# the full decision to the response callback, where the lane is known)
+CODE_MEMPOOL_FULL = 0xF001
+
+
 @dataclass
 class MempoolTx:
     height: int  # height when tx was validated
     gas_wanted: int
     tx: bytes
+    priority: int = 0
+    lane: int = 0
 
 
 class TxCache:
@@ -92,6 +123,10 @@ class Mempool(MempoolIface):
         wal_group=None,
         metrics=None,
         logger=None,
+        lane_bounds: Sequence[int] = (),
+        checktx_batch: int = 1,
+        checktx_batch_wait: float = 0.005,
+        recheck_batch: int = 0,
     ):
         self._proxy = proxy_app
         self._txs = CList()
@@ -100,6 +135,8 @@ class Mempool(MempoolIface):
         self._rechecking = False
         self._recheck_cursor: Optional[CElement] = None
         self._recheck_end: Optional[CElement] = None
+        self._recheck_pending = 0
+        self._stale_recheck = 0
         self._notified_txs_available = False
         self._txs_available: Optional[threading.Event] = None
         self._max_size = size
@@ -109,6 +146,25 @@ class Mempool(MempoolIface):
         self._mtx = threading.RLock()  # the consensus Lock/Unlock boundary
         self._wal = wal_group
         self.metrics = metrics
+        # priority lanes: ascending thresholds; priority >= bounds[i] rides
+        # lane i+1. Lane dicts hold CElement -> None in insertion (FIFO)
+        # order beside the gossip CList.
+        self._lane_bounds = tuple(sorted(lane_bounds))
+        self._lanes: List[Dict[CElement, None]] = [
+            {} for _ in range(len(self._lane_bounds) + 1)
+        ]
+        # micro-batching (1 = flush per submission, reference behavior)
+        self._checktx_batch = max(1, int(checktx_batch))
+        self._checktx_batch_wait = checktx_batch_wait
+        self._recheck_batch = max(0, int(recheck_batch))
+        self._pending_flush = 0
+        self._pending_since = 0.0
+        self._flush_timer: Optional[threading.Timer] = None
+        # seam for planner-based batched signature verification: when set,
+        # called with the list of raw txs in each CheckTx/recheck window
+        # before the flush that dispatches them
+        self.batch_check_hook: Optional[Callable[[List[bytes]], None]] = None
+        self._batch_txs: List[bytes] = []
         import logging
 
         self.logger = logger or logging.getLogger("tm.mempool")
@@ -125,6 +181,22 @@ class Mempool(MempoolIface):
     def size(self) -> int:
         return len(self._txs)
 
+    def n_lanes(self) -> int:
+        return len(self._lanes)
+
+    def lane_of(self, priority: int) -> int:
+        lane = 0
+        for bound in self._lane_bounds:
+            if priority >= bound:
+                lane += 1
+            else:
+                break
+        return lane
+
+    def lane_sizes(self) -> List[int]:
+        with self._mtx:
+            return [len(lane) for lane in self._lanes]
+
     def flush_app_conn(self) -> None:
         self._proxy.flush_sync()
 
@@ -138,6 +210,9 @@ class Mempool(MempoolIface):
                 self._txs.remove(el)
                 el = nxt
             self._tx_map.clear()
+            for lane in self._lanes:
+                lane.clear()
+            self._update_lane_metrics()
 
     def txs_front(self) -> Optional[CElement]:
         return self._txs.front()
@@ -159,12 +234,59 @@ class Mempool(MempoolIface):
             self._notified_txs_available = True
             self._txs_available.set()
 
+    # element bookkeeping ---------------------------------------------------
+    def _add_tx(self, memtx: MempoolTx) -> CElement:
+        el = self._txs.push_back(memtx)
+        self._tx_map[tmhash(memtx.tx)] = el
+        self._lanes[memtx.lane][el] = None
+        return el
+
+    def _remove_el(self, el: CElement, *, from_cache: bool) -> None:
+        if el.removed:
+            return
+        self._txs.remove(el)
+        memtx = el.value
+        self._tx_map.pop(tmhash(memtx.tx), None)
+        self._lanes[memtx.lane].pop(el, None)
+        if from_cache:
+            self.cache.remove(memtx.tx)
+
+    def _update_lane_metrics(self) -> None:
+        if self.metrics is None or len(self._lanes) <= 1:
+            return
+        for i, lane in enumerate(self._lanes):
+            self.metrics.mempool_lane_txs.set(len(lane), (str(i),))
+
+    def _evict_for_lane(self, lane: int) -> bool:
+        """Make room for an incoming lane-`lane` tx: drop the oldest tx from
+        the lowest occupied lane strictly below it.  False = nothing
+        evictable (the newcomer is rejected instead)."""
+        for low in range(lane):
+            if self._lanes[low]:
+                victim = next(iter(self._lanes[low]))
+                self._remove_el(victim, from_cache=True)
+                self.logger.debug(
+                    "evicted lane-%d tx for lane-%d arrival", low, lane
+                )
+                if self.metrics is not None:
+                    self.metrics.mempool_qos_evicted_total.add(1.0, (str(low),))
+                return True
+        return False
+
     # CheckTx ---------------------------------------------------------------
     def check_tx(self, tx: bytes, callback: Optional[Callable] = None) -> None:
         """Queue tx for app validation; good txs enter the list
-        (mempool.go:301)."""
+        (mempool.go:301).
+
+        Single-lane configs keep the reference contract: a full pool raises
+        ``MempoolFullError`` synchronously.  With lanes configured the full
+        decision needs the tx's priority, so it is deferred to the response
+        callback — the tx either evicts a lower-lane victim or comes back
+        with ``code=CODE_MEMPOOL_FULL``.
+        """
+        flush = False
         with self._mtx:
-            if self.size() >= self._max_size:
+            if self.size() >= self._max_size and len(self._lanes) == 1:
                 raise MempoolFullError(self.size(), self._max_size)
             if len(tx) > self._max_tx_bytes:
                 raise MempoolError(f"tx too large ({len(tx)} bytes)")
@@ -176,23 +298,101 @@ class Mempool(MempoolIface):
             rr = self._proxy.check_tx_async(tx)
             if callback is not None:
                 rr.set_callback(lambda req, res: callback(res))
+            if self._pending_flush == 0:
+                self._pending_since = time.perf_counter()
+            self._pending_flush += 1
+            self._batch_txs.append(tx)
+            if (self._checktx_batch <= 1
+                    or self._pending_flush >= self._checktx_batch):
+                flush = True
+            elif self._flush_timer is None:
+                t = threading.Timer(
+                    self._checktx_batch_wait, self._flush_deadline
+                )
+                t.daemon = True
+                self._flush_timer = t
+                t.start()
+        if flush:
+            self._flush_checktx_batch()
+
+    def _flush_deadline(self) -> None:
+        # batch-wait timer: flush whatever has accumulated
+        with self._mtx:
+            self._flush_timer = None
+        self._flush_checktx_batch()
+
+    def _flush_checktx_batch(self) -> None:
+        """Close the current micro-batch: one app-conn flush window for
+        every CheckTx accumulated since the last one."""
+        with self._mtx:
+            n = self._pending_flush
+            if n == 0:
+                return
+            self._pending_flush = 0
+            batch_txs, self._batch_txs = self._batch_txs, []
+            if self._flush_timer is not None:
+                self._flush_timer.cancel()
+                self._flush_timer = None
+            pack_s = time.perf_counter() - self._pending_since
+            if self.batch_check_hook is not None:
+                self.batch_check_hook(batch_txs)
+        t0 = time.perf_counter()
         self._proxy.flush_async()
+        run_s = time.perf_counter() - t0
+        if self._checktx_batch > 1:
+            get_profiler().record(
+                "mempool.checktx_batch",
+                bucket=(n,),
+                lanes_present=n,
+                pack_seconds=pack_s,
+                run_seconds=run_s,
+            )
+        if self.metrics is not None:
+            self.metrics.mempool_checktx_batch_size.observe(n)
 
     def _res_cb(self, req, res) -> None:
         if isinstance(res, abci.ResponseCheckTx):
-            if self._recheck_cursor is None:
-                self._res_cb_normal(req, res)
-            else:
-                self._res_cb_recheck(req, res)
+            with self._mtx:
+                if self._stale_recheck > 0:
+                    # a commit aborted the recheck round these belong to;
+                    # responses arrive in send order, so the next N CheckTx
+                    # responses are exactly the aborted round's leftovers
+                    self._stale_recheck -= 1
+                    return
+                if self._rechecking:
+                    self._res_cb_recheck(req, res)
+                else:
+                    self._res_cb_normal(req, res)
+                self._update_lane_metrics()
             if self.metrics is not None:
                 self.metrics.mempool_size.set(self.size())
 
     def _res_cb_normal(self, req: abci.RequestCheckTx, res: abci.ResponseCheckTx) -> None:
         tx = req.tx
         if res.code == abci.CODE_TYPE_OK:
-            memtx = MempoolTx(height=self._height, gas_wanted=res.gas_wanted, tx=tx)
-            el = self._txs.push_back(memtx)
-            self._tx_map[tmhash(tx)] = el
+            priority = res.priority if res.priority else res.gas_wanted
+            lane = self.lane_of(priority)
+            if self.size() >= self._max_size:
+                # full: admit by evicting below, else reject this tx —
+                # the rejection is stamped on the response so RPC callbacks
+                # (broadcast_tx_sync/commit) surface it to the submitter
+                if not self._evict_for_lane(lane):
+                    self.logger.debug(
+                        "full mempool rejected lane-%d tx", lane
+                    )
+                    if self.metrics is not None:
+                        self.metrics.mempool_failed_txs.add(1)
+                    self.cache.remove(tx)
+                    res.code = CODE_MEMPOOL_FULL
+                    res.log = (
+                        f"mempool is full: {self.size()} >= {self._max_size}"
+                    )
+                    return
+            memtx = MempoolTx(
+                height=self._height, gas_wanted=res.gas_wanted, tx=tx,
+                priority=priority, lane=lane,
+            )
+            self._add_tx(memtx)
             if self.metrics is not None:
                 self.metrics.mempool_tx_size_bytes.observe(len(tx))
             self.logger.debug("added good tx size=%d", self.size())
@@ -206,61 +406,96 @@ class Mempool(MempoolIface):
     def _res_cb_recheck(self, req: abci.RequestCheckTx, res: abci.ResponseCheckTx) -> None:
         if self.metrics is not None:
             self.metrics.mempool_recheck_times.add(1)
+        self._recheck_pending -= 1
         cursor = self._recheck_cursor
-        memtx = cursor.value
-        if memtx.tx != req.tx:
-            self.logger.error("recheck transaction mismatch")
-        if res.code != abci.CODE_TYPE_OK:
-            # committed-state invalidated this tx
-            self._txs.remove(cursor)
-            self._tx_map.pop(tmhash(memtx.tx), None)
-            self.cache.remove(memtx.tx)
-        if cursor is self._recheck_end:
-            self._recheck_cursor = None
-            self._rechecking = False
+        el: Optional[CElement] = None
+        if (cursor is not None and not cursor.removed
+                and cursor.value.tx == req.tx):
+            el = cursor
         else:
-            self._recheck_cursor = cursor.next()
+            # desync: the cursor's tx was removed mid-recheck (committed
+            # while responses were in flight). Resynchronize on the live
+            # element for THIS response via the hash index; a response for
+            # a tx no longer in the pool is simply dropped.
+            el = self._tx_map.get(tmhash(req.tx))
+            if el is not None and el.removed:
+                el = None
+            if el is not None:
+                self.logger.warning(
+                    "recheck transaction mismatch; cursor resynchronized"
+                )
+            else:
+                self.logger.debug(
+                    "recheck response for tx no longer in pool; dropped"
+                )
+        if el is not None:
+            if res.code != abci.CODE_TYPE_OK:
+                # committed-state invalidated this tx
+                self._remove_el(el, from_cache=True)
+            # removed elements keep their next pointer, so this advances
+            # correctly even when the walk crossed removed territory
+            self._recheck_cursor = el.next()
+        if self._recheck_pending <= 0:
+            self._recheck_cursor = None
+            self._recheck_end = None
+            self._rechecking = False
 
     # Reap ------------------------------------------------------------------
     def reap_max_bytes_max_gas(self, max_bytes: int, max_gas: int) -> List[bytes]:
-        """Collect txs for a proposal under byte/gas budgets (mempool.go:471)."""
+        """Collect txs for a proposal under byte/gas budgets (mempool.go:471).
+
+        Lanes serve high to low, FIFO within a lane; single-lane configs
+        degrade to pure insertion order (the reference behavior)."""
         with self._mtx:
             total_bytes = 0
             total_gas = 0
             out: List[bytes] = []
-            for memtx in self._txs:
-                sz = len(memtx.tx) + 8  # frame overhead allowance
-                if max_bytes > -1 and total_bytes + sz > max_bytes:
-                    break
-                if max_gas > -1 and total_gas + memtx.gas_wanted > max_gas:
-                    break
-                total_bytes += sz
-                total_gas += memtx.gas_wanted
-                out.append(memtx.tx)
+            for lane in reversed(self._lanes):
+                for el in lane:
+                    memtx = el.value
+                    sz = len(memtx.tx) + 8  # frame overhead allowance
+                    if max_bytes > -1 and total_bytes + sz > max_bytes:
+                        return out
+                    if max_gas > -1 and total_gas + memtx.gas_wanted > max_gas:
+                        return out
+                    total_bytes += sz
+                    total_gas += memtx.gas_wanted
+                    out.append(memtx.tx)
             return out
 
     def reap_max_txs(self, n: int) -> List[bytes]:
         with self._mtx:
-            out = []
-            for memtx in self._txs:
-                if len(out) >= n >= 0:
-                    break
-                out.append(memtx.tx)
+            out: List[bytes] = []
+            for lane in reversed(self._lanes):
+                for el in lane:
+                    if len(out) >= n >= 0:
+                        return out
+                    out.append(el.value.tx)
             return out
 
     # Update (after commit; mempool locked by the executor) -----------------
     def update(self, height: int, txs, pre_check=None, post_check=None) -> None:
         """Remove committed txs, recheck the rest (mempool.go:531)."""
         self._height = height
+        if self._rechecking:
+            # the previous round never finished (async app conn): its
+            # in-flight responses describe pre-commit state, so mark them
+            # stale rather than letting them race the new round's cursor
+            self._stale_recheck += self._recheck_pending
+            self._recheck_pending = 0
+            self._recheck_cursor = None
+            self._recheck_end = None
+            self._rechecking = False
         self._notified_txs_available = False
         if self._txs_available is not None:
             self._txs_available.clear()
         for tx in txs:
             tx = bytes(tx)
             self.cache.push(tx)  # committed: keep in cache so re-adds fail
-            el = self._tx_map.pop(tmhash(tx), None)
-            if el is not None and not el.removed:
-                self._txs.remove(el)
+            el = self._tx_map.get(tmhash(tx))
+            if el is not None:
+                self._remove_el(el, from_cache=False)
+        self._update_lane_metrics()
         if self._recheck_enabled and self.size() > 0:
             self._recheck_txs()
         else:
@@ -270,8 +505,39 @@ class Mempool(MempoolIface):
         with trace.span("mempool.recheck", n=self.size()):
             self._recheck_cursor = self._txs.front()
             self._recheck_end = self._txs.back()
+            self._recheck_pending = self.size()
             self._rechecking = True
-            for memtx in self._txs:
-                self._proxy.check_tx_async(memtx.tx)
-            self._proxy.flush_async()
+            batch = self._recheck_batch or self.size()
+            sent: List[bytes] = []
+            t_pack = time.perf_counter()
+            # snapshot first: with a local app conn, responses arrive inline
+            # and mutate the list while we would still be walking it
+            survivors = [memtx.tx for memtx in self._txs]
+            for tx in survivors:
+                self._proxy.check_tx_async(tx)
+                sent.append(tx)
+                if len(sent) >= batch:
+                    self._flush_recheck_batch(sent, t_pack)
+                    sent = []
+                    t_pack = time.perf_counter()
+            if sent:
+                self._flush_recheck_batch(sent, t_pack)
         self._notify_txs_available()
+
+    def _flush_recheck_batch(self, batch_txs: List[bytes], t_pack: float) -> None:
+        if self.batch_check_hook is not None:
+            self.batch_check_hook(batch_txs)
+        pack_s = time.perf_counter() - t_pack
+        t0 = time.perf_counter()
+        self._proxy.flush_async()
+        run_s = time.perf_counter() - t0
+        if self._recheck_batch > 0:
+            get_profiler().record(
+                "mempool.recheck_batch",
+                bucket=(len(batch_txs),),
+                lanes_present=len(batch_txs),
+                pack_seconds=pack_s,
+                run_seconds=run_s,
+            )
+        if self.metrics is not None:
+            self.metrics.mempool_checktx_batch_size.observe(len(batch_txs))

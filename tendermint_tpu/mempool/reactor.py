@@ -17,6 +17,7 @@ from typing import Optional
 from tendermint_tpu.encoding.codec import Reader, Writer
 from tendermint_tpu.libs.gossip import walk_and_send
 from tendermint_tpu.mempool.mempool import Mempool, MempoolError
+from tendermint_tpu.mempool.qos import MempoolQoS
 from tendermint_tpu.p2p.base_reactor import Reactor
 from tendermint_tpu.p2p.conn.connection import ChannelDescriptor
 
@@ -40,14 +41,25 @@ def decode_tx_msg(data: bytes) -> bytes:
 
 
 class MempoolReactor(Reactor):
-    def __init__(self, mempool: Mempool, peer_height_lookup=None, config=None):
+    def __init__(self, mempool: Mempool, peer_height_lookup=None, config=None,
+                 metrics=None, now_ns=None):
         """peer_height_lookup(peer_id) -> Optional[int]: the peer's consensus
         height, normally ConsensusReactor.peer_height (wired by the node /
-        harness); None = assume caught up."""
+        harness); None = assume caught up.
+
+        When ``config.qos_enabled`` (a MempoolConfig) the per-peer admission
+        controller gates every received tx before CheckTx; ``now_ns`` is the
+        QoS clock (a SimClock in the simulator)."""
         super().__init__(name="MempoolReactor")
         self.mempool = mempool
         self.config = config
         self._peer_height_lookup = peer_height_lookup
+        self.qos: Optional[MempoolQoS] = None
+        if config is not None and getattr(config, "qos_enabled", False):
+            kwargs = {"metrics": metrics}
+            if now_ns is not None:
+                kwargs["now_ns"] = now_ns
+            self.qos = MempoolQoS(config, **kwargs)
 
     def get_channels(self):
         return [
@@ -74,17 +86,30 @@ class MempoolReactor(Reactor):
             name=f"mempool-gossip-{peer.id[:8]}",
             daemon=True,
         ).start()
-    # remove_peer: nothing to clean — the broadcast thread exits on
-    # peer.is_running
+    def remove_peer(self, peer, reason=None) -> None:
+        # the broadcast thread exits on peer.is_running; only the QoS
+        # ledger needs explicit cleanup (label-cardinality hygiene)
+        if self.qos is not None:
+            self.qos.forget_peer(peer.id)
 
     def receive(self, chan_id: int, peer, msg_bytes: bytes) -> None:
         if len(msg_bytes) > MAX_MSG_SIZE:
             raise ValueError("oversized mempool message")
         tx = decode_tx_msg(msg_bytes)
+        if self.qos is not None:
+            ok, _reason = self.qos.admit(peer.id, len(tx))
+            if not ok:
+                return  # counted (qos_dropped_total{reason}) — not silent
         try:
             self.mempool.check_tx(tx)
         except MempoolError:
             pass  # dup/full/bad txs are unremarkable from gossip
+
+    def qos_snapshot(self):
+        """Per-peer admission ledger for the dump_mempool_qos RPC."""
+        if self.qos is None:
+            return {"enabled": False, "peers": {}}
+        return self.qos.snapshot()
 
     # -- per-peer walker ---------------------------------------------------------
     def _broadcast_tx_routine(self, peer) -> None:

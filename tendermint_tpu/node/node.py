@@ -181,6 +181,9 @@ class Node(BaseService):
             recheck=config.mempool.recheck,
             wal_group=mempool_wal,
             metrics=self.metrics,
+            lane_bounds=config.mempool.lane_bounds,
+            checktx_batch=config.mempool.checktx_batch,
+            recheck_batch=config.mempool.recheck_batch,
         )
         if config.consensus.wait_for_txs():
             self.mempool.enable_txs_available()
@@ -301,10 +304,12 @@ class Node(BaseService):
                 syncer=syncer,
                 on_synced=self._on_statesync_complete,
             )
-        mem_reactor = MempoolReactor(
+        # kept on self: dump_mempool_qos serves its per-peer admission ledger
+        self.mempool_reactor = mem_reactor = MempoolReactor(
             self.mempool,
             peer_height_lookup=self.consensus_reactor.peer_height,
             config=config.mempool,
+            metrics=self.metrics,
         )
         ev_reactor = EvidenceReactor(
             self.evidence_pool,

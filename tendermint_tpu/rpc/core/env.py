@@ -7,6 +7,7 @@ Every handler returns JSON-able dicts.  Errors raise RPCError(code, message).
 from __future__ import annotations
 
 import base64
+import contextlib
 import os
 import queue
 import threading
@@ -14,6 +15,7 @@ import time
 from typing import Any, Dict, List, Optional
 
 from tendermint_tpu.abci import types as abci
+from tendermint_tpu.mempool.mempool import MempoolFullError, TxInCacheError
 from tendermint_tpu.types.events import EVENT_TX, TX_HASH_KEY, query_for_event
 
 
@@ -22,6 +24,11 @@ class RPCError(Exception):
         super().__init__(message)
         self.code = code
         self.message = message
+
+
+# broadcast_tx_* shed under overload: explicit, immediately distinguishable
+# from a generic internal error so clients can back off instead of retrying
+ERR_MEMPOOL_OVERLOADED = -32001
 
 
 def _b64(b: bytes) -> str:
@@ -33,6 +40,44 @@ class RPCEnv:
 
     def __init__(self, node):
         self.node = node
+        self._broadcast_mtx = threading.Lock()
+        self._broadcast_in_flight = 0
+        self.broadcast_shed: Dict[str, int] = {}
+
+    # load-shedding: broadcast_tx_* share one bounded in-flight budget; at
+    # the cap new submissions fail fast with a mempool-overloaded error
+    # instead of queueing unboundedly behind CheckTx / commit waits
+    @contextlib.contextmanager
+    def _broadcast_slot(self, route: str):
+        cfg = getattr(self.node, "config", None)
+        budget = getattr(cfg.rpc, "broadcast_max_in_flight", 0) if cfg else 0
+        with self._broadcast_mtx:
+            if budget > 0 and self._broadcast_in_flight >= budget:
+                self.broadcast_shed[route] = self.broadcast_shed.get(route, 0) + 1
+                m = getattr(self.node, "metrics", None)
+                if m is not None:
+                    m.mempool_qos_shed_total.add(1.0, (route,))
+                raise RPCError(
+                    ERR_MEMPOOL_OVERLOADED,
+                    f"mempool overloaded: {self._broadcast_in_flight} "
+                    f"broadcast_tx requests in flight (budget {budget})",
+                )
+            self._broadcast_in_flight += 1
+        try:
+            yield
+        finally:
+            with self._broadcast_mtx:
+                self._broadcast_in_flight -= 1
+
+    def _check_tx_guarded(self, raw: bytes, callback=None) -> None:
+        """check_tx with mempool admission errors mapped to explicit RPC
+        errors (a full pool is overload, a cache hit is a client dup)."""
+        try:
+            self.node.mempool.check_tx(raw, callback=callback)
+        except MempoolFullError as e:
+            raise RPCError(ERR_MEMPOOL_OVERLOADED, f"mempool overloaded: {e}")
+        except TxInCacheError as e:
+            raise RPCError(-32603, str(e))
 
     # info ------------------------------------------------------------------
     def health(self) -> dict:
@@ -333,19 +378,21 @@ class RPCEnv:
     # tx --------------------------------------------------------------------
     def broadcast_tx_async(self, tx: str) -> dict:
         raw = base64.b64decode(tx)
-        self.node.mempool.check_tx(raw)
+        with self._broadcast_slot("async"):
+            self._check_tx_guarded(raw)
         import hashlib
 
         return {"code": 0, "data": "", "log": "", "hash": hashlib.sha256(raw).hexdigest().upper()}
 
     def broadcast_tx_sync(self, tx: str) -> dict:
         raw = base64.b64decode(tx)
-        done: "queue.Queue" = queue.Queue()
-        self.node.mempool.check_tx(raw, callback=done.put)
-        try:
-            res = done.get(timeout=10)
-        except queue.Empty:
-            raise RPCError(-32603, "CheckTx timed out")
+        with self._broadcast_slot("sync"):
+            done: "queue.Queue" = queue.Queue()
+            self._check_tx_guarded(raw, callback=done.put)
+            try:
+                res = done.get(timeout=10)
+            except queue.Empty:
+                raise RPCError(-32603, "CheckTx timed out")
         import hashlib
 
         return {
@@ -357,46 +404,49 @@ class RPCEnv:
 
     def broadcast_tx_commit(self, tx: str) -> dict:
         """Subscribe to the tx event, CheckTx, wait for commit
-        (rpc/core/mempool.go:152)."""
+        (rpc/core/mempool.go:152).  The in-flight slot is claimed BEFORE the
+        event-bus subscription, so a shed request never leaks a
+        subscription (and never holds one while rejected)."""
         raw = base64.b64decode(tx)
         import hashlib
 
         tx_hash = hashlib.sha256(raw).hexdigest().upper()
-        bus = self.node.event_bus
-        sub_id = f"broadcast-{tx_hash}-{time.monotonic_ns()}"
-        sub = bus.subscribe(
-            sub_id, f"{query_for_event(EVENT_TX)} AND {TX_HASH_KEY} = '{tx_hash}'"
-        )
-        try:
-            done: "queue.Queue" = queue.Queue()
-            self.node.mempool.check_tx(raw, callback=done.put)
+        with self._broadcast_slot("commit"):
+            bus = self.node.event_bus
+            sub_id = f"broadcast-{tx_hash}-{time.monotonic_ns()}"
+            sub = bus.subscribe(
+                sub_id, f"{query_for_event(EVENT_TX)} AND {TX_HASH_KEY} = '{tx_hash}'"
+            )
             try:
-                check_res = done.get(timeout=10)
-            except queue.Empty:
-                raise RPCError(-32603, "CheckTx timed out")
-            if check_res.code != abci.CODE_TYPE_OK:
+                done: "queue.Queue" = queue.Queue()
+                self._check_tx_guarded(raw, callback=done.put)
+                try:
+                    check_res = done.get(timeout=10)
+                except queue.Empty:
+                    raise RPCError(-32603, "CheckTx timed out")
+                if check_res.code != abci.CODE_TYPE_OK:
+                    return {
+                        "check_tx": _tx_res_json(check_res),
+                        "deliver_tx": {},
+                        "hash": tx_hash,
+                        "height": 0,
+                    }
+                try:
+                    msg = sub.get(timeout=30)
+                except queue.Empty:
+                    raise RPCError(-32603, "timed out waiting for tx to be committed")
+                ev = msg.data
                 return {
                     "check_tx": _tx_res_json(check_res),
-                    "deliver_tx": {},
+                    "deliver_tx": _tx_res_json(ev.result),
                     "hash": tx_hash,
-                    "height": 0,
+                    "height": ev.height,
                 }
-            try:
-                msg = sub.get(timeout=30)
-            except queue.Empty:
-                raise RPCError(-32603, "timed out waiting for tx to be committed")
-            ev = msg.data
-            return {
-                "check_tx": _tx_res_json(check_res),
-                "deliver_tx": _tx_res_json(ev.result),
-                "hash": tx_hash,
-                "height": ev.height,
-            }
-        finally:
-            try:
-                bus.unsubscribe_all(sub_id)
-            except Exception:
-                pass
+            finally:
+                try:
+                    bus.unsubscribe_all(sub_id)
+                except Exception:
+                    pass
 
     def tx(self, hash: str, prove: bool = False) -> dict:
         raw_hash = bytes.fromhex(hash)
@@ -600,6 +650,39 @@ class RPCEnv:
         wd = getattr(self.node, "watchdog", None)
         out["stall"] = wd.report() if wd is not None else None
         return out
+
+    def dump_mempool_qos(self) -> dict:
+        """Per-peer mempool admission ledger (token levels, drops by
+        reason, mute state), lane occupancy, and the RPC broadcast
+        load-shed counters — the dump_consensus_state of the ingestion
+        path.  Gated like dump_trace: per-peer traffic accounting leaks
+        topology."""
+        self._require_unsafe()
+        reactor = getattr(self.node, "mempool_reactor", None)
+        qos = (
+            reactor.qos_snapshot()
+            if reactor is not None and hasattr(reactor, "qos_snapshot")
+            else {"enabled": False, "peers": {}}
+        )
+        mp = self.node.mempool
+        cfg = getattr(self.node, "config", None)
+        with self._broadcast_mtx:
+            rpc = {
+                "in_flight": self._broadcast_in_flight,
+                "budget": getattr(cfg.rpc, "broadcast_max_in_flight", 0)
+                if cfg else 0,
+                "shed": dict(self.broadcast_shed),
+            }
+        return {
+            "qos": qos,
+            "mempool": {
+                "size": mp.size(),
+                "max_size": getattr(mp, "_max_size", None),
+                "lane_sizes": mp.lane_sizes()
+                if hasattr(mp, "lane_sizes") else [],
+            },
+            "rpc": rpc,
+        }
 
     def flight_reset(self, enable=None, capacity=None) -> dict:
         """Clear the flight-recorder ring; optionally flip it on/off

@@ -27,6 +27,7 @@ from tendermint_tpu.crypto.keys import PrivKeyEd25519
 from tendermint_tpu.evidence.pool import EvidencePool
 from tendermint_tpu.evidence.reactor import EvidenceReactor
 from tendermint_tpu.libs.db.kv import MemDB
+from tendermint_tpu.libs.metrics import NodeMetrics
 from tendermint_tpu.libs.watchdog import LivenessWatchdog
 from tendermint_tpu.mempool.mempool import Mempool
 from tendermint_tpu.mempool.reactor import MempoolReactor
@@ -78,7 +79,18 @@ class SimNode:
         self.app = app or KVStoreApp()
         self.conn = MultiAppConn(LocalClientCreator(self.app))
         self.conn.start()
-        self.mempool = Mempool(self.conn.mempool)
+        # per-node registry so scenarios can assert on QoS/lane counters
+        self.metrics = NodeMetrics()
+        self.mempool = Mempool(
+            self.conn.mempool,
+            size=cfg.mempool.size,
+            cache_size=cfg.mempool.cache_size,
+            recheck=cfg.mempool.recheck,
+            metrics=self.metrics,
+            lane_bounds=cfg.mempool.lane_bounds,
+            checktx_batch=cfg.mempool.checktx_batch,
+            recheck_batch=cfg.mempool.recheck_batch,
+        )
         self.evpool = EvidencePool(self.state_db, MemDB(), st.copy())
         self.block_store = BlockStore(MemDB())
 
@@ -101,7 +113,8 @@ class SimNode:
 
         self.reactor = ConsensusReactor(self.cs)
         self.mempool_reactor = MempoolReactor(
-            self.mempool, peer_height_lookup=self.reactor.peer_height
+            self.mempool, peer_height_lookup=self.reactor.peer_height,
+            config=cfg.mempool, metrics=self.metrics, now_ns=self.clock,
         )
         self.evidence_reactor = EvidenceReactor(
             self.evpool, peer_height_lookup=self.reactor.peer_height

@@ -13,6 +13,8 @@ baseline_determinism  frozen clocks + blocktime_iota → commit hashes are a
                       and requires identical hashes
 partition_heal        2-2 split: no quorum ⇒ no progress while split,
                       progress resumes within budget after heal
+partition_heal_9      the same claim at n_vals=9 (4-5 split) — the larger
+                      validator-set variant of the matrix
 storm                 delay + jitter + 10% drop + duplicates + reorder on
                       every link; chain still advances
 clock_skew            ±2s wall-clock skews; trace_merge's commit-anchor
@@ -24,6 +26,9 @@ equivocation          Byzantine double-signer ⇒ DuplicateVoteEvidence is
                       and marked committed in every pool
 silence_watchdog      >1/3 power silenced ⇒ watchdog stall report names
                       the silenced validators' cumulative power; heals
+mempool_flood         one node spams ~10x the per-peer QoS rate ⇒ honest
+                      priority txs still commit, mempools stay bounded,
+                      drops land in tendermint_mempool_qos_* counters
 ====================  =====================================================
 """
 
@@ -91,14 +96,16 @@ def _check_all_nodes_agree_everywhere(run: ScenarioRun) -> List[str]:
     return failures
 
 
-def partition_heal() -> Scenario:
+def partition_heal(n_vals: int = 4) -> Scenario:
+    split = n_vals // 2  # both halves < 2/3 quorum for any n_vals >= 4
+
     def drive(run: ScenarioRun) -> List[str]:
         failures = []
         if not run.wait_height(2, 30.0):
             return [f"never reached height 3 pre-partition: {run.heights()}"]
         run.fabric.set_partition(
-            [{run.nodes[0].node_id, run.nodes[1].node_id},
-             {run.nodes[2].node_id, run.nodes[3].node_id}]
+            [{n.node_id for n in run.nodes[:split]},
+             {n.node_id for n in run.nodes[split:]}]
         )
         # let in-flight messages settle, then sample the frozen heights
         run.wait_for(lambda: False, timeout=1.0)
@@ -107,10 +114,14 @@ def partition_heal() -> Scenario:
         after = run.mark("partition_end")["heights"]
         if before != after:
             failures.append(
-                f"progress during 2-2 partition: {before} -> {after}"
+                f"progress during {split}-{n_vals - split} partition: "
+                f"{before} -> {after}"
             )
         run.fabric.heal_partition()
-        if not run.wait_height(max(after) + 2, 30.0):
+        # bigger nets pay 9 single-sig Python verifies per commit plus
+        # post-partition round realignment: give them a wider heal budget
+        heal_budget = 30.0 if n_vals == 4 else 90.0
+        if not run.wait_height(max(after) + 2, heal_budget):
             failures.append(
                 f"liveness: no progress within budget after heal: "
                 f"{run.heights()} (was {after})"
@@ -118,11 +129,13 @@ def partition_heal() -> Scenario:
         return failures
 
     return Scenario(
-        name="partition_heal",
-        description="2-2 partition freezes the chain (no 2/3 quorum); "
-                    "healing restores progress within budget",
+        name="partition_heal" if n_vals == 4 else f"partition_heal_{n_vals}",
+        description=f"{split}-{n_vals - split} partition freezes the chain "
+                    "(no 2/3 quorum); healing restores progress within "
+                    "budget",
+        n_vals=n_vals,
         seed=2,
-        timeout_s=90.0,
+        timeout_s=90.0 if n_vals == 4 else 180.0,
         drive=drive,
     )
 
@@ -371,12 +384,122 @@ def silence_watchdog() -> Scenario:
     )
 
 
+def mempool_flood() -> Scenario:
+    """One node floods spam txs at ~10x the per-peer QoS budget while
+    consensus runs.  Honest high-priority txs must still commit, every
+    node's mempool stays bounded at `size`, the spammer's bucket saturates
+    (per-peer drop counts on honest nodes), and the drops are visible in
+    the tendermint_mempool_qos_* metric exposition."""
+    from tendermint_tpu.abci.examples.kvstore import PriorityKVStoreApp
+    from tendermint_tpu.mempool.mempool import MempoolError
+
+    MAX_TXS = 100
+    SPAM = 600  # >> qos_peer_tx_burst + rate x run-length: must saturate
+
+    def config():
+        cfg = test_config()
+        cfg.mempool.size = MAX_TXS
+        cfg.mempool.qos_peer_tx_rate = 50.0
+        cfg.mempool.qos_peer_tx_burst = 25.0
+        # keep peers unmuted so the scenario measures steady-state rate
+        # limiting, not the (separately unit-tested) mute escalation
+        cfg.mempool.qos_mute_after = 0
+        return cfg
+
+    honest_txs = [b"pri2000:hon%d=x" % i for i in range(5)]
+    honest_keys = [tx.split(b"=", 1)[0] for tx in honest_txs]
+
+    def drive(run: ScenarioRun) -> List[str]:
+        failures = []
+        if not run.wait_height(1, 30.0):
+            return [f"never warmed up: {run.heights()}"]
+        spammer = run.nodes[3]
+        # local submissions bypass QoS (it guards the peer boundary); the
+        # flood reaches honest nodes via gossip, where their buckets bite
+        for i in range(SPAM):
+            try:
+                spammer.mempool.check_tx(b"spam%06d=x" % i)
+            except MempoolError:
+                pass
+        for tx in honest_txs:
+            try:
+                run.nodes[0].mempool.check_tx(tx)
+            except MempoolError as e:
+                failures.append(f"honest tx rejected at submission: {e}")
+        committed = run.wait_for(
+            lambda: all(
+                all(k in n.app.state for k in honest_keys)
+                for n in run.nodes
+            ),
+            timeout=60.0,
+        )
+        if not committed:
+            missing = {
+                n.node_id: [k.decode() for k in honest_keys
+                            if k not in n.app.state]
+                for n in run.nodes
+            }
+            failures.append(
+                f"honest txs not committed everywhere under flood: {missing}"
+            )
+        return failures
+
+    def check(run: ScenarioRun) -> List[str]:
+        failures = []
+        spammer_id = run.nodes[3].node_id
+        for node in run.nodes:
+            if node.mempool.size() > MAX_TXS:
+                failures.append(
+                    f"{node.node_id}: mempool size {node.mempool.size()} "
+                    f"exceeds max_txs {MAX_TXS}"
+                )
+        # the spammer's bucket must have saturated on at least one honest
+        # node (gossip dedup means not every node necessarily hears the
+        # full flood directly from the spammer)
+        drops = {}
+        for node in run.nodes[:3]:
+            peers = node.mempool_reactor.qos_snapshot()["peers"]
+            drops[node.node_id] = peers.get(spammer_id, {}).get("dropped", 0)
+        if not any(d > 0 for d in drops.values()):
+            failures.append(
+                f"no honest node rate-limited the spammer: drops={drops}"
+            )
+        # ...and the decision must be visible on the wire format operators
+        # actually scrape
+        for node in run.nodes[:3]:
+            if drops[node.node_id] == 0:
+                continue
+            text = node.metrics.registry.expose_text()
+            if "tendermint_mempool_qos_dropped_total" not in text:
+                failures.append(
+                    f"{node.node_id}: qos drop counter missing from "
+                    f"metric exposition"
+                )
+        return failures
+
+    return Scenario(
+        name="mempool_flood",
+        description="one node spams txs at ~10x the per-peer QoS rate; "
+                    "honest priority txs still commit, mempools stay "
+                    "bounded, and the spammer's drops land in the "
+                    "tendermint_mempool_qos_* counters",
+        seed=8,
+        timeout_s=120.0,
+        config_factory=config,
+        app_factory=lambda i: PriorityKVStoreApp(),
+        drive=drive,
+        check=check,
+    )
+
+
 SCENARIOS: Dict[str, Callable[[], Scenario]] = {
     "baseline_determinism": baseline_determinism,
     "partition_heal": partition_heal,
+    "partition_heal_9": lambda: partition_heal(n_vals=9),
     "storm": storm,
     "clock_skew": clock_skew,
     "churn": churn,
     "equivocation": equivocation,
     "silence_watchdog": silence_watchdog,
+    "mempool_flood": mempool_flood,
 }

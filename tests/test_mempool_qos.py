@@ -1,0 +1,683 @@
+"""Mempool admission control: token-bucket refill math, fairness under
+contention, repeat-offender muting, priority-lane reap/eviction order,
+batched CheckTx/recheck windows, recheck cursor resync, and RPC
+load-shedding.
+
+Every clocked assertion runs against an injected ``SimClock`` stepped by
+hand — refill and mute arithmetic is checked to the token, with zero
+wall-clock dependence.
+"""
+
+import base64
+import queue
+import threading
+from types import SimpleNamespace
+
+import pytest
+
+from tendermint_tpu.abci import types as abci
+from tendermint_tpu.abci.client import ReqRes
+from tendermint_tpu.abci.examples.kvstore import KVStoreApp, PriorityKVStoreApp
+from tendermint_tpu.config.config import MempoolConfig
+from tendermint_tpu.libs.metrics import NodeMetrics
+from tendermint_tpu.mempool.mempool import (
+    CODE_MEMPOOL_FULL,
+    Mempool,
+    MempoolFullError,
+)
+from tendermint_tpu.mempool.qos import (
+    ADMIT,
+    DROP_BYTE_RATE,
+    DROP_FAIR,
+    DROP_MUTED,
+    DROP_TX_RATE,
+    MempoolQoS,
+    TokenBucket,
+)
+from tendermint_tpu.mempool.reactor import MempoolReactor, encode_tx_msg
+from tendermint_tpu.proxy.app_conn import LocalClientCreator, MultiAppConn
+from tendermint_tpu.rpc.core.env import ERR_MEMPOOL_OVERLOADED, RPCEnv, RPCError
+from tendermint_tpu.sim.clock import SimClock
+
+SEC = 1_000_000_000  # ns
+
+
+def stepped_clock(start_ns: int = 1 * SEC) -> SimClock:
+    """A frozen SimClock advanced explicitly via .freeze(t)."""
+    return SimClock(frozen_at_ns=start_ns)
+
+
+# ---------------------------------------------------------------------------
+# TokenBucket
+# ---------------------------------------------------------------------------
+
+
+class TestTokenBucket:
+    def test_refill_math_is_exact(self):
+        clk = stepped_clock()
+        b = TokenBucket(rate=10.0, burst=5.0, now_ns=clk)
+        # starts full
+        assert b.level() == 5.0
+        for _ in range(5):
+            assert b.try_consume(1.0)
+        assert not b.try_consume(1.0)
+        # 0.25s at 10/s refills exactly 2.5 tokens
+        clk.freeze(clk.now_ns() + SEC // 4)
+        assert b.level() == pytest.approx(2.5)
+        assert b.try_consume(2.0)
+        assert not b.try_consume(1.0)  # only 0.5 left
+        # refill caps at burst no matter how long we sleep
+        clk.freeze(clk.now_ns() + 1000 * SEC)
+        assert b.level() == 5.0
+
+    def test_zero_rate_disables(self):
+        clk = stepped_clock()
+        b = TokenBucket(rate=0.0, burst=0.0, now_ns=clk)
+        assert all(b.try_consume(1.0) for _ in range(100))
+
+    def test_overdraft_floor(self):
+        clk = stepped_clock()
+        b = TokenBucket(rate=10.0, burst=2.0, now_ns=clk)
+        assert b.try_consume(2.0)
+        # empty; reserve of 2 allows exactly two more unit draws
+        assert b.consume_with_overdraft(1.0, floor=2.0)
+        assert b.consume_with_overdraft(1.0, floor=2.0)
+        assert not b.consume_with_overdraft(1.0, floor=2.0)
+        assert b.level() == pytest.approx(-2.0)
+
+    def test_clock_never_goes_backwards_in_refill(self):
+        clk = stepped_clock(start_ns=10 * SEC)
+        b = TokenBucket(rate=10.0, burst=5.0, now_ns=clk)
+        assert b.try_consume(5.0)
+        clk.freeze(9 * SEC)  # host clock hiccup: one second backwards
+        assert b.level() == 0.0  # negative delta must not drain or refill
+
+
+# ---------------------------------------------------------------------------
+# MempoolQoS: per-peer limits, fairness, muting
+# ---------------------------------------------------------------------------
+
+
+def qos_config(**kw) -> MempoolConfig:
+    defaults = dict(
+        qos_enabled=True,
+        qos_peer_tx_rate=2.0,
+        qos_peer_tx_burst=2.0,
+        qos_peer_byte_rate=1000.0,
+        qos_peer_byte_burst=1000.0,
+        qos_global_tx_rate=0.0,
+        qos_mute_after=0,
+    )
+    defaults.update(kw)
+    return MempoolConfig(**defaults)
+
+
+class TestMempoolQoS:
+    def test_peer_tx_rate_limit(self):
+        clk = stepped_clock()
+        q = MempoolQoS(qos_config(), now_ns=clk)
+        assert q.admit("p1", 10) == (True, ADMIT)
+        assert q.admit("p1", 10) == (True, ADMIT)
+        assert q.admit("p1", 10) == (False, DROP_TX_RATE)
+        # refill one token after half a second at 2 tx/s
+        clk.freeze(clk.now_ns() + SEC // 2)
+        assert q.admit("p1", 10) == (True, ADMIT)
+        assert q.admit("p1", 10) == (False, DROP_TX_RATE)
+
+    def test_peer_byte_rate_limit(self):
+        clk = stepped_clock()
+        q = MempoolQoS(
+            qos_config(qos_peer_tx_rate=1000.0, qos_peer_tx_burst=1000.0,
+                       qos_peer_byte_rate=100.0, qos_peer_byte_burst=100.0),
+            now_ns=clk,
+        )
+        assert q.admit("p1", 60) == (True, ADMIT)
+        assert q.admit("p1", 60) == (False, DROP_BYTE_RATE)
+        assert q.admit("p1", 40) == (True, ADMIT)
+
+    def test_peers_are_isolated(self):
+        clk = stepped_clock()
+        q = MempoolQoS(qos_config(), now_ns=clk)
+        q.admit("spam", 1)
+        q.admit("spam", 1)
+        assert q.admit("spam", 1)[0] is False
+        # a different peer has its own full bucket
+        assert q.admit("honest", 1) == (True, ADMIT)
+
+    def test_mute_escalates_and_forgives(self):
+        clk = stepped_clock()
+        q = MempoolQoS(
+            qos_config(qos_mute_after=2, qos_mute_base_s=1.0,
+                       qos_mute_max_s=60.0, qos_forgive_s=10.0),
+            now_ns=clk,
+        )
+        q.admit("p", 1)
+        q.admit("p", 1)  # bucket drained
+        assert q.admit("p", 1) == (False, DROP_TX_RATE)
+        assert q.admit("p", 1) == (False, DROP_TX_RATE)  # 2nd violation: mute
+        st = q.peer_state("p")
+        assert st["muted"] and st["offenses"] == 1
+        mute1_until = st["muted_until_ns"]
+        assert mute1_until == clk.now_ns() + 1 * SEC  # base duration
+        assert q.admit("p", 1) == (False, DROP_MUTED)
+        # serve the mute; bucket also refills meanwhile (2 tx/s, 2s)
+        clk.freeze(mute1_until + 1)
+        assert q.admit("p", 1) == (True, ADMIT)
+        # re-offend within the forgiveness window: mute doubles to 2s
+        q.admit("p", 1)
+        q.admit("p", 1)
+        q.admit("p", 1)
+        q.admit("p", 1)
+        st = q.peer_state("p")
+        assert st["muted"] and st["offenses"] == 2
+        assert st["muted_until_ns"] - clk.now_ns() == 2 * SEC
+        # a long clean stretch after the mute expires forgives the index
+        clk.freeze(st["muted_until_ns"] + 11 * SEC)
+        assert q.admit("p", 1) == (True, ADMIT)
+        assert q.peer_state("p")["offenses"] == 0
+
+    def test_fairness_spammer_cannot_starve_honest_peer(self):
+        clk = stepped_clock()
+        q = MempoolQoS(
+            qos_config(
+                qos_peer_tx_rate=1000.0, qos_peer_tx_burst=1000.0,
+                qos_global_tx_rate=10.0, qos_global_tx_burst=10.0,
+                qos_fair_reserve=5.0, qos_fair_slack=1.0,
+                qos_fair_window_s=1.0,
+            ),
+            now_ns=clk,
+        )
+        # the honest peer shows up once; the spammer drains the rest of
+        # the aggregate budget (fair share only means something once the
+        # window has more than one participant)
+        assert q.admit("honest", 1) == (True, ADMIT)
+        for _ in range(9):
+            assert q.admit("spam", 1) == (True, ADMIT)
+        # over its fair share of the drained window, the spammer is shed...
+        assert q.admit("spam", 1) == (False, DROP_FAIR)
+        # ...but the under-share peer still gets in via the bounded reserve
+        assert q.admit("honest", 1) == (True, ADMIT)
+        assert q.admit("spam", 1) == (False, DROP_FAIR)
+
+    def test_decisions_are_deterministic_replay(self):
+        """Same call schedule + same injected clock => identical decision
+        stream (the property chaos replay relies on)."""
+        schedule = (
+            [("spam", 1, 0)] * 8 + [("honest", 1, 0)] * 2
+            + [("spam", 1, SEC // 10)] * 6 + [("honest", 1, SEC // 5)] * 3
+        )
+
+        def run():
+            clk = stepped_clock()
+            q = MempoolQoS(
+                qos_config(qos_peer_tx_rate=4.0, qos_peer_tx_burst=4.0,
+                           qos_global_tx_rate=8.0, qos_global_tx_burst=8.0,
+                           qos_mute_after=3, qos_mute_base_s=0.5),
+                now_ns=clk,
+            )
+            out = []
+            for peer, nbytes, advance_ns in schedule:
+                clk.freeze(clk.now_ns() + advance_ns)
+                out.append(q.admit(peer, nbytes))
+            return out
+
+        assert run() == run()
+
+    def test_forget_peer_resets_ledger(self):
+        clk = stepped_clock()
+        q = MempoolQoS(qos_config(), now_ns=clk)
+        q.admit("p", 1)
+        q.admit("p", 1)
+        assert q.admit("p", 1)[0] is False
+        q.forget_peer("p")
+        assert q.admit("p", 1) == (True, ADMIT)  # fresh bucket
+
+    def test_drop_metrics_and_snapshot(self):
+        clk = stepped_clock()
+        m = NodeMetrics()
+        q = MempoolQoS(qos_config(), metrics=m, now_ns=clk)
+        q.admit("p", 1)
+        q.admit("p", 1)
+        q.admit("p", 1)  # drop
+        text = m.registry.expose_text()
+        assert "tendermint_mempool_qos_admitted_total 2" in text
+        assert 'tendermint_mempool_qos_dropped_total{reason="tx_rate"} 1' in text
+        snap = q.snapshot()
+        assert snap["enabled"] is True
+        assert snap["peers"]["p"]["admitted"] == 2
+        assert snap["peers"]["p"]["dropped"] == 1
+        assert snap["peers"]["p"]["last_drop_reason"] == DROP_TX_RATE
+
+
+# ---------------------------------------------------------------------------
+# Reactor gate
+# ---------------------------------------------------------------------------
+
+
+class _FakePeer:
+    def __init__(self, pid):
+        self.id = pid
+
+
+class TestReactorGate:
+    def test_receive_drops_over_limit_txs(self):
+        conn = MultiAppConn(LocalClientCreator(KVStoreApp()))
+        conn.start()
+        mp = Mempool(conn.mempool)
+        clk = stepped_clock()
+        cfg = qos_config(qos_peer_tx_rate=2.0, qos_peer_tx_burst=2.0)
+        reactor = MempoolReactor(mp, config=cfg, now_ns=clk)
+        peer = _FakePeer("noisy")
+        for i in range(5):
+            reactor.receive(0, peer, encode_tx_msg(b"t%d=%d" % (i, i)))
+        assert mp.size() == 2  # bucket admitted exactly burst
+        snap = reactor.qos_snapshot()
+        assert snap["peers"]["noisy"]["admitted"] == 2
+        assert snap["peers"]["noisy"]["dropped"] == 3
+        # disconnect drops the ledger
+        reactor.remove_peer(peer, None)
+        assert "noisy" not in reactor.qos_snapshot()["peers"]
+
+    def test_reactor_without_config_admits_everything(self):
+        conn = MultiAppConn(LocalClientCreator(KVStoreApp()))
+        conn.start()
+        mp = Mempool(conn.mempool)
+        reactor = MempoolReactor(mp)
+        assert reactor.qos is None
+        for i in range(10):
+            reactor.receive(0, _FakePeer("p"), encode_tx_msg(b"x%d=%d" % (i, i)))
+        assert mp.size() == 10
+        assert reactor.qos_snapshot() == {"enabled": False, "peers": {}}
+
+
+# ---------------------------------------------------------------------------
+# Priority lanes: reap order + eviction order
+# ---------------------------------------------------------------------------
+
+
+def lane_mempool(size=100, bounds=(1, 1024), **kw):
+    conn = MultiAppConn(LocalClientCreator(PriorityKVStoreApp()))
+    conn.start()
+    return Mempool(conn.mempool, size=size, lane_bounds=bounds, **kw)
+
+
+class TestPriorityLanes:
+    def test_lane_of_thresholds(self):
+        mp = lane_mempool(bounds=(1, 1024))
+        assert mp.n_lanes() == 3
+        assert mp.lane_of(0) == 0
+        assert mp.lane_of(1) == 1
+        assert mp.lane_of(1023) == 1
+        assert mp.lane_of(1024) == 2
+        assert mp.lane_of(10**9) == 2
+
+    def test_reap_serves_high_lanes_first_fifo_within(self):
+        mp = lane_mempool()
+        mp.check_tx(b"low0=a")          # priority 0 -> lane 0
+        mp.check_tx(b"pri5:mid0=b")     # lane 1
+        mp.check_tx(b"pri2000:hi0=c")   # lane 2
+        mp.check_tx(b"pri7:mid1=d")     # lane 1, after mid0
+        mp.check_tx(b"pri1500:hi1=e")   # lane 2, after hi0
+        assert mp.lane_sizes() == [1, 2, 2]
+        assert mp.reap_max_bytes_max_gas(-1, -1) == [
+            b"pri2000:hi0=c", b"pri1500:hi1=e",
+            b"pri5:mid0=b", b"pri7:mid1=d",
+            b"low0=a",
+        ]
+        # reap_max_txs honors the same order under a count budget
+        assert mp.reap_max_txs(2) == [b"pri2000:hi0=c", b"pri1500:hi1=e"]
+
+    def test_full_pool_evicts_lowest_lane_first(self):
+        mp = lane_mempool(size=3, bounds=(10,))
+        mp.check_tx(b"low0=a")
+        mp.check_tx(b"low1=b")
+        mp.check_tx(b"pri100:hi0=c")
+        assert mp.size() == 3
+        # full: a high-lane arrival evicts the OLDEST lowest-lane tx
+        mp.check_tx(b"pri100:hi1=d")
+        assert mp.size() == 3
+        txs = mp.reap_max_bytes_max_gas(-1, -1)
+        assert b"low0=a" not in txs
+        assert txs == [b"pri100:hi0=c", b"pri100:hi1=d", b"low1=b"]
+        # the evicted tx may re-enter later (it was dropped, not committed)
+        mp.check_tx(b"pri100:hi2=e")
+        assert b"low1=b" not in mp.reap_max_bytes_max_gas(-1, -1)
+
+    def test_full_pool_rejects_when_no_lower_lane(self):
+        mp = lane_mempool(size=2, bounds=(10,))
+        mp.check_tx(b"pri100:hi0=a")
+        mp.check_tx(b"pri100:hi1=b")
+        results = []
+        # same-lane arrival cannot evict: rejected via the response code
+        mp.check_tx(b"pri100:hi2=c", callback=results.append)
+        assert mp.size() == 2
+        assert results and results[0].code == CODE_MEMPOOL_FULL
+        assert "full" in results[0].log
+        # a LOW arrival can never evict anything either
+        mp.check_tx(b"low=x", callback=results.append)
+        assert results[1].code == CODE_MEMPOOL_FULL
+        assert mp.size() == 2
+
+    def test_eviction_never_exceeds_max_and_prefers_oldest(self):
+        """Property-style sweep: interleave priorities, assert size cap and
+        that every eviction removed a strictly-lower lane's oldest entry."""
+        mp = lane_mempool(size=5, bounds=(10, 100))
+        prios = [0, 5, 20, 150, 0, 30, 200, 7, 999, 50, 2, 120]
+        for i, p in enumerate(prios):
+            tx = b"pri%d:k%02d=v" % (p, i) if p else b"k%02d=v" % i
+            mp.check_tx(tx)
+            assert mp.size() <= 5
+        assert mp.size() == 5
+        reaped = mp.reap_max_bytes_max_gas(-1, -1)
+        lanes = [mp.lane_of(PriorityKVStoreApp.tx_priority(t)) for t in reaped]
+        assert lanes == sorted(lanes, reverse=True)  # high lanes first
+        # all surviving high-lane txs beat every dropped low-lane tx
+        assert mp.lane_sizes()[2] == sum(1 for p in prios if p >= 100)
+
+    def test_single_lane_keeps_sync_full_error(self):
+        conn = MultiAppConn(LocalClientCreator(KVStoreApp()))
+        conn.start()
+        mp = Mempool(conn.mempool, size=1)
+        mp.check_tx(b"a=1")
+        with pytest.raises(MempoolFullError):
+            mp.check_tx(b"b=2")
+
+
+# ---------------------------------------------------------------------------
+# Deferred app conn: recheck cursor desync + stale-round draining
+# ---------------------------------------------------------------------------
+
+
+class DeferredConn:
+    """Mempool-facing app conn whose responses can be held back and
+    delivered one by one — simulates a socket ABCI conn where CheckTx
+    responses race commits.  Mirrors LocalClient's ordering contract:
+    global callback first, then the ReqRes completion."""
+
+    def __init__(self, app=None):
+        self.app = app or PriorityKVStoreApp()
+        self._cb = None
+        self.deferred = False
+        self.pending = []
+        self.flushes = 0
+
+    def set_response_callback(self, cb):
+        self._cb = cb
+
+    def check_tx_async(self, tx):
+        req = abci.RequestCheckTx(tx=tx)
+        rr = ReqRes(req)
+        res = self.app.check_tx(req)
+        if self.deferred:
+            self.pending.append((rr, res))
+        else:
+            self._complete(rr, res)
+        return rr
+
+    def _complete(self, rr, res):
+        self._cb(rr.request, res)
+        rr.complete(res)
+
+    def deliver(self, n=1):
+        for _ in range(n):
+            rr, res = self.pending.pop(0)
+            self._complete(rr, res)
+
+    def deliver_all(self):
+        self.deliver(len(self.pending))
+
+    def flush_async(self):
+        self.flushes += 1
+
+    def flush_sync(self):
+        pass
+
+
+class TestRecheckDesync:
+    def _mempool(self, **kw):
+        conn = DeferredConn()
+        mp = Mempool(conn, recheck=True, **kw)
+        return mp, conn
+
+    def test_commit_mid_recheck_aborts_stale_round(self):
+        """Regression for the cursor-desync bug: a commit lands while a
+        recheck round's responses are still in flight.  The stale round must
+        be drained without touching the new round's cursor, and no tx may be
+        lost or duplicated."""
+        mp, conn = self._mempool()
+        for tx in (b"a=1", b"b=2", b"c=3"):
+            mp.check_tx(tx)
+        assert mp.size() == 3
+        conn.deferred = True
+        mp.lock()
+        try:
+            mp.update(2, [])  # recheck round 1: 3 responses now in flight
+        finally:
+            mp.unlock()
+        conn.deliver(1)  # a=1 rechecked OK; cursor now at b=2
+        # height 3 commits b=2 while 2 round-1 responses are still pending
+        mp.lock()
+        try:
+            mp.update(3, [b"b=2"])
+        finally:
+            mp.unlock()
+        # round-1 leftovers (b, c) drain without perturbing round 2 ...
+        conn.deliver(2)
+        assert mp.size() == 2
+        # ... and round 2's own responses complete the walk
+        conn.deliver_all()
+        assert not conn.pending
+        assert sorted(mp.reap_max_bytes_max_gas(-1, -1)) == [b"a=1", b"c=3"]
+        assert mp.size() == 2  # no duplicates from stale responses
+        # the mempool is back to a clean steady state: next round works
+        conn.deferred = False
+        mp.lock()
+        try:
+            mp.update(4, [b"a=1"])
+        finally:
+            mp.unlock()
+        assert mp.reap_max_bytes_max_gas(-1, -1) == [b"c=3"]
+
+    def test_cursor_resyncs_after_concurrent_removal(self):
+        """A tx at the cursor vanishes mid-round (eviction): the next
+        response must resynchronize via the hash index instead of walking
+        off a removed element."""
+        mp, conn = self._mempool()
+        for tx in (b"a=1", b"b=2", b"c=3"):
+            mp.check_tx(tx)
+        conn.deferred = True
+        mp.lock()
+        try:
+            mp.update(2, [])
+        finally:
+            mp.unlock()
+        # simulate a concurrent removal of the tx the cursor points at
+        from tendermint_tpu.crypto.hashing import tmhash
+
+        with mp._mtx:
+            mp._remove_el(mp._tx_map[tmhash(b"a=1")], from_cache=True)
+        conn.deliver_all()  # a's response is dropped; b and c resync
+        assert mp.size() == 2
+        assert sorted(mp.reap_max_bytes_max_gas(-1, -1)) == [b"b=2", b"c=3"]
+
+    def test_recheck_removes_newly_invalid_txs(self):
+        class RejectOddApp(PriorityKVStoreApp):
+            def __init__(self):
+                super().__init__()
+                self.reject = set()
+
+            def check_tx(self, req):
+                if req.tx in self.reject:
+                    return abci.ResponseCheckTx(code=7, log="stale")
+                return super().check_tx(req)
+
+        conn = DeferredConn(app=RejectOddApp())
+        mp = Mempool(conn, recheck=True)
+        for tx in (b"a=1", b"b=2", b"c=3"):
+            mp.check_tx(tx)
+        conn.app.reject.add(b"b=2")  # committed state invalidated b
+        mp.lock()
+        try:
+            mp.update(2, [])
+        finally:
+            mp.unlock()
+        assert mp.reap_max_bytes_max_gas(-1, -1) == [b"a=1", b"c=3"]
+        # b was removed from the cache too: it may be resubmitted
+        conn.app.reject.discard(b"b=2")
+        mp.check_tx(b"b=2")
+        assert mp.size() == 3
+
+
+# ---------------------------------------------------------------------------
+# Batched CheckTx / recheck windows
+# ---------------------------------------------------------------------------
+
+
+class TestBatchedCheckTx:
+    def test_batch_one_flushes_per_submission(self):
+        conn = DeferredConn()
+        mp = Mempool(conn, checktx_batch=1)
+        for i in range(3):
+            mp.check_tx(b"t%d=%d" % (i, i))
+        assert conn.flushes == 3
+
+    def test_batch_flushes_once_per_window(self):
+        conn = DeferredConn()
+        mp = Mempool(conn, checktx_batch=3, checktx_batch_wait=60.0)
+        seen = []
+        mp.batch_check_hook = seen.append
+        for i in range(6):
+            mp.check_tx(b"t%d=%d" % (i, i))
+        assert conn.flushes == 2  # two full windows of three
+        assert [len(b) for b in seen] == [3, 3]
+        assert mp.size() == 6
+
+    def test_partial_batch_flushes_on_deadline(self):
+        conn = DeferredConn()
+        mp = Mempool(conn, checktx_batch=8, checktx_batch_wait=0.02)
+        mp.check_tx(b"solo=1")
+        assert conn.flushes == 0  # below the window, timer armed
+        deadline = threading.Event()
+        for _ in range(100):
+            if conn.flushes:
+                deadline.set()
+                break
+            threading.Event().wait(0.01)
+        assert deadline.is_set(), "deadline timer never flushed the window"
+        assert mp.size() == 1
+
+    def test_recheck_batches_through_hook(self):
+        conn = DeferredConn()
+        mp = Mempool(conn, recheck=True, recheck_batch=2)
+        for i in range(5):
+            mp.check_tx(b"r%d=%d" % (i, i))
+        flushes_before = conn.flushes
+        windows = []
+        mp.batch_check_hook = windows.append
+        mp.lock()
+        try:
+            mp.update(2, [])
+        finally:
+            mp.unlock()
+        # 5 survivors in windows of 2: 2+2+1
+        assert [len(w) for w in windows] == [2, 2, 1]
+        assert conn.flushes - flushes_before == 3
+        assert mp.size() == 5
+
+
+# ---------------------------------------------------------------------------
+# RPC load-shedding
+# ---------------------------------------------------------------------------
+
+
+class _RecordingBus:
+    def __init__(self):
+        self.subscribed = []
+        self.unsubscribed = []
+
+    def subscribe(self, sub_id, query):
+        self.subscribed.append(sub_id)
+        return queue.Queue()
+
+    def unsubscribe(self, sub_id):
+        self.unsubscribed.append(sub_id)
+
+
+def make_rpc_env(budget=1, mempool_size=100):
+    conn = MultiAppConn(LocalClientCreator(KVStoreApp()))
+    conn.start()
+    mp = Mempool(conn.mempool, size=mempool_size)
+    node = SimpleNamespace(
+        config=SimpleNamespace(
+            rpc=SimpleNamespace(broadcast_max_in_flight=budget)
+        ),
+        mempool=mp,
+        metrics=NodeMetrics(),
+        event_bus=_RecordingBus(),
+    )
+    return RPCEnv(node), node
+
+
+def b64tx(raw: bytes) -> str:
+    return base64.b64encode(raw).decode()
+
+
+class TestRPCLoadShed:
+    def test_sync_sheds_at_budget_then_recovers(self):
+        env, node = make_rpc_env(budget=1)
+        with env._broadcast_slot("sync"):  # one request in flight
+            with pytest.raises(RPCError) as ei:
+                env.broadcast_tx_sync(b64tx(b"shed=1"))
+        assert ei.value.code == ERR_MEMPOOL_OVERLOADED
+        assert "overloaded" in ei.value.message
+        assert env.broadcast_shed == {"sync": 1}
+        assert (
+            'tendermint_mempool_qos_shed_total{route="sync"} 1'
+            in node.metrics.registry.expose_text()
+        )
+        # the slot is back: the same submission now succeeds
+        res = env.broadcast_tx_sync(b64tx(b"shed=1"))
+        assert res["code"] == 0
+        assert node.mempool.size() == 1
+
+    def test_commit_shed_never_leaks_subscription(self):
+        env, node = make_rpc_env(budget=1)
+        with env._broadcast_slot("commit"):
+            with pytest.raises(RPCError) as ei:
+                env.broadcast_tx_commit(b64tx(b"c=1"))
+        assert ei.value.code == ERR_MEMPOOL_OVERLOADED
+        assert node.event_bus.subscribed == []  # shed before subscribe
+        assert env.broadcast_shed == {"commit": 1}
+
+    def test_async_shed_and_budget_zero_is_unbounded(self):
+        env, _ = make_rpc_env(budget=1)
+        with env._broadcast_slot("async"):
+            with pytest.raises(RPCError):
+                env.broadcast_tx_async(b64tx(b"a=1"))
+        env2, node2 = make_rpc_env(budget=0)
+        with env2._broadcast_slot("async"):
+            res = env2.broadcast_tx_async(b64tx(b"a=1"))  # 0 = old behavior
+        assert res["code"] == 0
+        assert node2.mempool.size() == 1
+
+    def test_full_mempool_maps_to_overloaded_error(self):
+        env, node = make_rpc_env(budget=8, mempool_size=1)
+        env.broadcast_tx_sync(b64tx(b"fits=1"))
+        with pytest.raises(RPCError) as ei:
+            env.broadcast_tx_sync(b64tx(b"spill=1"))
+        assert ei.value.code == ERR_MEMPOOL_OVERLOADED
+        assert node.mempool.size() == 1
+
+    def test_dump_mempool_qos_route(self):
+        env, node = make_rpc_env(budget=4)
+        node.config.rpc.unsafe = True
+        node.mempool_reactor = MempoolReactor(
+            node.mempool, config=qos_config(), now_ns=stepped_clock()
+        )
+        node.mempool_reactor.receive(0, _FakePeer("p1"), encode_tx_msg(b"q=1"))
+        out = env.dump_mempool_qos()
+        assert out["qos"]["enabled"] is True
+        assert out["qos"]["peers"]["p1"]["admitted"] == 1
+        assert out["mempool"]["size"] == 1
+        assert out["rpc"]["budget"] == 4
+        assert out["rpc"]["in_flight"] == 0
