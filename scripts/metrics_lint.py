@@ -298,6 +298,13 @@ def _self_check():
     vm.window_heights.observe(512.0)
     vm.record_planner(680, 1024, compiled=True)
     vm.record_planner(680, 1024)
+    # device dispatch guard family (libs/breaker.py)
+    vm.device_breaker_state.set(1.0)
+    vm.device_fallback.add(1.0, ("timeout",))
+    vm.device_fallback.add(1.0, ("audit_mismatch",))
+    vm.device_retries.add(1.0)
+    vm.device_audit.add(8.0, ("ok",))
+    vm.device_audit.add(1.0, ("mismatch",))
 
     nm = NodeMetrics()
     # exercise the hot-path families so the lint covers sample lines, not
@@ -344,6 +351,26 @@ def _self_check():
     if missing:
         failures.append(
             ("reference-name parity", [f"missing family {n}" for n in missing])
+        )
+    # device-guard family parity: the breaker gauge + fallback/retry/audit
+    # counters tm_monitor's DEVICE column and the runbooks scrape must keep
+    # these exact names (libs/breaker.py wires them, VerifyMetrics owns them,
+    # and NodeMetrics attaches the verify registry into /metrics)
+    device_names = (
+        "tendermint_verify_device_breaker_state",
+        "tendermint_verify_device_fallback_total",
+        "tendermint_verify_device_retries_total",
+        "tendermint_verify_device_audit_total",
+    )
+    verify_text = vm.registry.expose_text()
+    missing_dev = [
+        n for n in device_names
+        if f"# TYPE {n} " not in verify_text or f"# TYPE {n} " not in node_text
+    ]
+    if missing_dev:
+        failures.append(
+            ("device-family parity",
+             [f"missing family {n}" for n in missing_dev])
         )
     for label, text in (
         ("escaping registry", r.expose_text()),

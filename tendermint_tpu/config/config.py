@@ -195,6 +195,30 @@ class StateSyncConfig:
 
 
 @dataclass
+class VerifyConfig:
+    """[verify] — fault tolerance for the device verification path
+    (libs/breaker.py).  Mirrors GuardConfig field names so the node
+    composition root can pass this section straight to
+    configure_device_guard."""
+
+    # consecutive device failures before the breaker opens
+    breaker_threshold: int = 3
+    # first open backoff (s); doubles per re-open up to breaker_backoff_max
+    breaker_backoff: float = 1.0
+    breaker_backoff_max: float = 60.0
+    # wall-clock deadline per device dispatch (s); <= 0 disables the
+    # supervising worker thread (a hung device then hangs the caller)
+    dispatch_deadline: float = 30.0
+    # fraction of device lanes cross-checked against the host oracle per
+    # window; a mismatch quarantines the device path (operator reset).
+    # 0 disables the audit, 1.0 re-verifies every lane on the host.
+    audit_sample_rate: float = 0.05
+    audit_seed: int = 0
+    # retries after a failed device dispatch before host fallback
+    retries: int = 1
+
+
+@dataclass
 class TxIndexConfig:
     indexer: str = "kv"  # "kv" | "null"
     index_tags: str = ""
@@ -226,6 +250,7 @@ class Config:
     mempool: MempoolConfig = field(default_factory=MempoolConfig)
     consensus: ConsensusConfig = field(default_factory=ConsensusConfig)
     statesync: StateSyncConfig = field(default_factory=StateSyncConfig)
+    verify: VerifyConfig = field(default_factory=VerifyConfig)
     tx_index: TxIndexConfig = field(default_factory=TxIndexConfig)
     instrumentation: InstrumentationConfig = field(default_factory=InstrumentationConfig)
 

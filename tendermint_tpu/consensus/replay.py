@@ -109,8 +109,9 @@ def replay_one_message(cs, tm) -> None:
         )
 
 
-def catchup_replay(cs, cs_height: int) -> None:
-    """Replay WAL messages since the last block (replay.go:97)."""
+def catchup_replay(cs, cs_height: int) -> int:
+    """Replay WAL messages since the last block (replay.go:97).  Returns the
+    number of messages replayed (0 when the WAL had nothing for us)."""
     cs.replay_mode = True
     try:
         # sanity: nothing for this height should be fully written already
@@ -125,12 +126,12 @@ def catchup_replay(cs, cs_height: int) -> None:
                 cs.logger.info(
                     "WAL has no #ENDHEIGHT %d — starting fresh", cs_height - 1
                 )
-                return
+                return 0
             # height 1: replay everything from the start
             try:
                 it = cs.wal.iter_all()
             except Exception:
-                return
+                return 0
         count = 0
         try:
             for tm in it:
@@ -139,6 +140,7 @@ def catchup_replay(cs, cs_height: int) -> None:
         except DataCorruptionError as e:
             cs.logger.error("WAL corruption during replay: %s", e)
         cs.logger.info("replayed %d WAL messages for height %d", count, cs_height)
+        return count
     finally:
         cs.replay_mode = False
 

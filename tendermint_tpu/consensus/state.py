@@ -113,6 +113,8 @@ class ConsensusState(BaseService):
         # spent in the step being LEFT (None until the first transition)
         self._step_started: Optional[float] = None
         self._step_leaving: Optional[str] = None
+        # messages re-fed from the WAL on the last start (crash recovery)
+        self.wal_replayed = 0
 
         self.priv_validator = None
 
@@ -175,7 +177,7 @@ class ConsensusState(BaseService):
         from tendermint_tpu.consensus.replay import catchup_replay
 
         if not isinstance(self.wal, NilWAL) and not self.skip_wal_catchup:
-            catchup_replay(self, self.rs.height)
+            self.wal_replayed = catchup_replay(self, self.rs.height)
         self.timeout_ticker.start()
         threading.Thread(target=self._ticker_forwarder, daemon=True).start()
         threading.Thread(target=self._receive_routine, daemon=True).start()

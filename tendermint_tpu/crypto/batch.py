@@ -228,8 +228,213 @@ class TPUBatchVerifier:
         return ok
 
 
+class GuardedBatchVerifier:
+    """Fault-tolerant wrapper around a device BatchVerifier.
+
+    Every dispatch runs the full guard (libs/breaker.py):
+
+      1. breaker gate — open/quarantined diverts straight to the host
+         oracle (bit-identical verdicts, just slower);
+      2. supervised deadline — a hung device call becomes a fallback,
+         not a stalled consensus routine;
+      3. bounded retry — one transient failure is retried before the
+         window completes on the host;
+      4. seeded silent-corruption audit — k sampled lanes per device
+         window are re-verified on the host oracle; any disagreement
+         quarantines the breaker (operator reset required) and the
+         window's verdict is recomputed entirely on the host, so a
+         wrong device verdict never escapes this class.
+
+    The wrapped device object only needs the BatchVerifier surface
+    (verify_ed25519 / verify_ed25519_raw / verify_secp256k1), which is
+    how the sim's FaultyDevice shim slots in.
+    """
+
+    name = "guarded"
+
+    def __init__(self, device, host=None, breaker=None, deadline=None,
+                 retries=None, audit_rate=None, audit_seed=None):
+        from tendermint_tpu.libs import breaker as _brk
+
+        cfg = _brk.guard_config()
+        self.device = device
+        self.host = host if host is not None else HostBatchVerifier()
+        self.breaker = breaker if breaker is not None \
+            else _brk.get_device_breaker()
+        self.deadline = cfg.dispatch_deadline if deadline is None else deadline
+        self.retries = cfg.retries if retries is None else int(retries)
+        self.audit_rate = (
+            cfg.audit_sample_rate if audit_rate is None else float(audit_rate)
+        )
+        self.audit_seed = cfg.audit_seed if audit_seed is None else int(audit_seed)
+        self.backend = getattr(
+            device, "backend", getattr(device, "name", "device")
+        )
+        self._mtx = threading.Lock()
+        self._dispatches = 0
+        self._audit_mismatches = 0
+
+    # -- BatchVerifier surface -------------------------------------------------
+
+    def verify_ed25519(self, items: Sequence[SigItem]) -> np.ndarray:
+        return self._guard(
+            "ed25519", len(items),
+            lambda: self.device.verify_ed25519(items),
+            lambda: self.host.verify_ed25519(items),
+            lambda i: _ed.verify(items[i].pubkey, items[i].msg, items[i].sig),
+        )
+
+    def verify_ed25519_raw(self, pubs, msgs, sigs) -> np.ndarray:
+        return self._guard(
+            "ed25519", len(pubs),
+            lambda: self.device.verify_ed25519_raw(pubs, msgs, sigs),
+            lambda: self.host.verify_ed25519_raw(pubs, msgs, sigs),
+            lambda i: _ed.verify(pubs[i], msgs[i], sigs[i]),
+        )
+
+    def verify_secp256k1(self, items: Sequence[SigItem]) -> np.ndarray:
+        from tendermint_tpu.crypto import secp256k1 as _secp
+        from tendermint_tpu.crypto.hashing import sha256
+
+        return self._guard(
+            "secp256k1", len(items),
+            lambda: self.device.verify_secp256k1(items),
+            lambda: self.host.verify_secp256k1(items),
+            lambda i: _secp.verify(
+                items[i].pubkey, sha256(items[i].msg), items[i].sig
+            ),
+        )
+
+    # -- guard machinery -------------------------------------------------------
+
+    def _guard(self, algo, n, dev_call, host_call, oracle) -> np.ndarray:
+        if n == 0:
+            return np.zeros((0,), dtype=bool)
+        from tendermint_tpu.libs import breaker as _brk
+
+        br = self.breaker
+        if not br.allow():
+            reason = (
+                "quarantined" if br.state == _brk.QUARANTINED
+                else "breaker_open"
+            )
+            self._note_fallback(reason, algo, n)
+            return np.asarray(host_call(), dtype=bool)
+        attempts = 0
+        while True:
+            try:
+                ok = _brk.supervised_call(
+                    dev_call, self.deadline, name=f"batch-{algo}"
+                )
+                ok = np.asarray(ok, dtype=bool)
+            except Exception as e:
+                timeout = isinstance(e, _brk.DispatchTimeout)
+                reason = "timeout" if timeout else "error"
+                br.record_failure(reason)
+                attempts += 1
+                if attempts <= self.retries and br.allow():
+                    try:
+                        get_verify_metrics().device_retries.add(1.0)
+                    except Exception:
+                        pass
+                    continue
+                self._note_fallback(reason, algo, n)
+                return np.asarray(host_call(), dtype=bool)
+            if self._audit(algo, n, ok, oracle):
+                # the device disagrees with the host oracle: safety bug.
+                # Quarantine (latched) and recompute the WHOLE window on
+                # the host — the sampled lanes say nothing about the rest.
+                br.quarantine(f"audit_mismatch:{algo}")
+                self._note_fallback("audit_mismatch", algo, n)
+                return np.asarray(host_call(), dtype=bool)
+            br.record_success()
+            return ok
+
+    def _audit(self, algo, n, ok, oracle) -> bool:
+        """Cross-check k seeded-sampled lanes against the host oracle.
+        Returns True iff any lane disagrees."""
+        rate = self.audit_rate
+        if rate <= 0 or oracle is None:
+            return False
+        import math
+        import random
+
+        with self._mtx:
+            seq = self._dispatches
+            self._dispatches += 1
+        k = min(n, max(1, int(math.ceil(n * rate))))
+        rng = random.Random((self.audit_seed << 20) ^ seq)
+        lanes = rng.sample(range(n), k)
+        bad = [i for i in lanes if bool(ok[i]) != bool(oracle(i))]
+        try:
+            m = get_verify_metrics()
+            if len(lanes) - len(bad):
+                m.device_audit.add(float(len(lanes) - len(bad)), ("ok",))
+            if bad:
+                m.device_audit.add(float(len(bad)), ("mismatch",))
+        except Exception:
+            pass
+        if bad:
+            with self._mtx:
+                self._audit_mismatches += len(bad)
+            try:
+                from tendermint_tpu.libs.profile import get_profiler
+
+                get_profiler().record_event(
+                    "audit_mismatch", algo=algo, backend=self.backend,
+                    sampled=len(lanes), mismatches=len(bad),
+                    lanes=bad[:8],
+                )
+            except Exception:
+                pass
+        return bool(bad)
+
+    def _note_fallback(self, reason, algo, n) -> None:
+        try:
+            get_verify_metrics().device_fallback.add(1.0, (reason,))
+        except Exception:
+            pass
+        try:
+            from tendermint_tpu.libs.profile import get_profiler
+
+            get_profiler().record_event(
+                "device_fallback", reason=reason, algo=algo, n=n,
+                backend=self.backend,
+            )
+        except Exception:
+            pass
+
+    def snapshot(self) -> dict:
+        with self._mtx:
+            return {
+                "backend": self.backend,
+                "deadline": self.deadline,
+                "retries": self.retries,
+                "audit_rate": self.audit_rate,
+                "dispatches": self._dispatches,
+                "audit_mismatches": self._audit_mismatches,
+            }
+
+
 _lock = threading.Lock()
 _default = None
+# why the lazy default latched the host path: None (device in use or host
+# explicitly installed) | "no_tpu" | "device_init_error".  Only the init
+# error is considered transient — the breaker's half-open probe re-drives
+# device selection for it (the satellite-1 fix: no more permanent latch).
+_latched_reason: Optional[str] = None
+
+
+def _try_device_default():
+    """One device-selection attempt: (verifier, latch_reason)."""
+    v = TPUBatchVerifier()
+    # dead/absent chip degrades the verifier to XLA — but on a CPU-only
+    # host the XLA kernel is ~100x slower than the host C path, so the
+    # lazy default only keeps the device verifier when the fused pipeline
+    # is actually reachable (TM_BATCH_VERIFIER=xla forces XLA instead)
+    if v.backend == "pallas":
+        return GuardedBatchVerifier(v), None
+    return HostBatchVerifier(), "no_tpu"
 
 
 def get_batch_verifier(prefer_tpu: bool = True):
@@ -237,8 +442,13 @@ def get_batch_verifier(prefer_tpu: bool = True):
 
     TM_BATCH_VERIFIER=host|xla|pallas overrides (deployment knob: small
     localnet validators with tiny commits want the host oracle — a tunneled
-    device round-trip per 4-signature commit is pure loss)."""
-    global _default
+    device round-trip per 4-signature commit is pure loss).  Device-backed
+    verifiers are wrapped in GuardedBatchVerifier, and a host latch caused
+    by a device-init error is re-probed when the breaker grants its
+    half-open probe."""
+    global _default, _latched_reason
+    from tendermint_tpu.libs.breaker import get_device_breaker
+
     with _lock:
         if _default is None:
             import os
@@ -247,36 +457,85 @@ def get_batch_verifier(prefer_tpu: bool = True):
             if forced == "host":
                 _default = HostBatchVerifier()
             elif forced in ("xla", "pallas"):
-                _default = TPUBatchVerifier(backend=forced)
+                _default = GuardedBatchVerifier(TPUBatchVerifier(backend=forced))
             elif prefer_tpu:
                 try:
-                    v = TPUBatchVerifier()
-                    # dead/absent chip degrades the verifier to XLA — but on
-                    # a CPU-only host the XLA kernel is ~100x slower than the
-                    # host C path, so the lazy default only keeps the device
-                    # verifier when the fused pipeline is actually reachable
-                    # (TM_BATCH_VERIFIER=xla forces the XLA backend instead)
-                    if v.backend == "pallas":
-                        _default = v
-                    else:
-                        _default = HostBatchVerifier()
+                    _default, _latched_reason = _try_device_default()
+                    if _latched_reason is not None:
                         get_verify_metrics().host_fallback.add(
-                            1.0, ("no_tpu",)
+                            1.0, (_latched_reason,)
                         )
                 except Exception:
                     _default = HostBatchVerifier()
+                    _latched_reason = "device_init_error"
                     get_verify_metrics().host_fallback.add(
                         1.0, ("device_init_error",)
                     )
-            else:
-                _default = HostBatchVerifier()
+                    # force the breaker open so re-probes are paced by its
+                    # exponential backoff instead of hammering init on
+                    # every commit verify
+                    get_device_breaker().trip("device_init_error")
+        elif _latched_reason == "device_init_error" and prefer_tpu:
+            # re-probe seam: the half-open probe budget decides when a
+            # recovered device is worth another (possibly slow) init
+            br = get_device_breaker()
+            if br.allow():
+                try:
+                    v, reason = _try_device_default()
+                    if reason is None:
+                        _default = v
+                        _latched_reason = None
+                        br.record_success()
+                    else:
+                        br.record_failure("no_tpu")
+                except Exception:
+                    br.record_failure("device_init_error")
         return _default
 
 
 def set_batch_verifier(v) -> None:
-    global _default
+    global _default, _latched_reason
     with _lock:
         _default = v
+        _latched_reason = None
+
+
+def reprobe(force: bool = False):
+    """Drop the lazy default and re-run device selection.
+
+    ``force=False`` only clears a host latch (a previous ``no_tpu`` /
+    ``device_init_error`` verdict); an explicitly installed verifier is
+    left alone.  ``force=True`` additionally forgets the tpu_probe
+    liveness cache, so a tunnel that came back after a dead verdict is
+    rediscovered — at the cost of a full probe timeout if it is still
+    dead.  Returns the (possibly new) default verifier."""
+    global _default, _latched_reason
+    with _lock:
+        if _latched_reason is None and not force:
+            return _default
+        _default = None
+        _latched_reason = None
+    if force:
+        from tendermint_tpu.libs.tpu_probe import clear_cache
+
+        clear_cache()
+    return get_batch_verifier()
+
+
+def verifier_info() -> dict:
+    """Current default-verifier identity for dump_device_health."""
+    with _lock:
+        v = _default
+        reason = _latched_reason
+    info = {
+        "installed": v is not None,
+        "name": getattr(v, "name", None) if v is not None else None,
+        "backend": getattr(v, "backend", None) if v is not None else None,
+        "latched_reason": reason,
+    }
+    if isinstance(v, GuardedBatchVerifier):
+        info["guard"] = v.snapshot()
+    return info
 
 
 def verify_items(items: Sequence[SigItem], verifier=None) -> np.ndarray:

@@ -361,6 +361,28 @@ class VerifyMetrics:
             "Planner (lane, segment) bucket lookups by event (hit|compile)",
             label_names=("event",),
         )
+        # device dispatch guard (libs/breaker.py): breaker state + the
+        # fallback/retry/audit outcomes of every guarded device dispatch
+        self.device_breaker_state = r.gauge(
+            "verify_device_breaker_state",
+            "Device verify circuit-breaker state "
+            "(0=closed 1=open 2=half_open 3=quarantined)",
+        )
+        self.device_fallback = r.counter(
+            "verify_device_fallback_total",
+            "Device dispatches completed on the host path instead, by reason",
+            label_names=("reason",),
+        )
+        self.device_retries = r.counter(
+            "verify_device_retries_total",
+            "Device dispatches retried after a transient failure",
+        )
+        self.device_audit = r.counter(
+            "verify_device_audit_total",
+            "Silent-corruption audit lane cross-checks by outcome "
+            "(ok|mismatch)",
+            label_names=("outcome",),
+        )
 
     def record_dispatch(self, backend: str, algo: str, n: int,
                         seconds: float, rejects: int = 0,

@@ -24,10 +24,12 @@ memory no matter how long the node runs.
 from __future__ import annotations
 
 import threading
+import time
 from contextlib import contextmanager
 from typing import Dict, Iterator, List, Optional
 
 _DEFAULT_CAPACITY = 4096
+_EVENT_CAPACITY = 512
 
 _tls = threading.local()
 
@@ -35,12 +37,19 @@ _tls = threading.local()
 class Profiler:
     """Bounded ring buffer of dispatch-cost entries."""
 
-    def __init__(self, capacity: int = _DEFAULT_CAPACITY):
+    def __init__(self, capacity: int = _DEFAULT_CAPACITY,
+                 event_capacity: int = _EVENT_CAPACITY):
         self._mtx = threading.Lock()
         self._capacity = max(1, int(capacity))
         self._entries: List[dict] = []
         self._dropped = 0
         self._seq = 0
+        # separate ring for rare, schema-free health events (breaker
+        # transitions, audit verdicts, fallbacks) so they survive long
+        # after the high-churn dispatch entries have rotated out
+        self._event_capacity = max(1, int(event_capacity))
+        self._events: List[dict] = []
+        self._events_dropped = 0
 
     # recording ---------------------------------------------------------------
 
@@ -100,7 +109,36 @@ class Profiler:
                 del self._entries[0]
                 self._dropped += 1
 
+    def record_event(self, kind: str, **fields) -> None:
+        """One health/state event (breaker transition, audit verdict,
+        host fallback) into the bounded event ring.  Unlike ``record``
+        the schema is free-form: kind plus whatever the event carries."""
+        entry = {"kind": kind, "wall_time": time.time()}
+        entry.update(fields)
+        win = getattr(_tls, "window", None)
+        if win is not None and "height_base" not in entry:
+            entry["height_base"] = win[0]
+        with self._mtx:
+            entry["seq"] = self._seq
+            self._seq += 1
+            self._events.append(entry)
+            if len(self._events) > self._event_capacity:
+                del self._events[0]
+                self._events_dropped += 1
+
     # querying ----------------------------------------------------------------
+
+    def events(self, kind: Optional[str] = None) -> List[dict]:
+        with self._mtx:
+            out = [dict(e) for e in self._events]
+        if kind is not None:
+            out = [e for e in out if e["kind"] == kind]
+        return out
+
+    @property
+    def events_dropped(self) -> int:
+        with self._mtx:
+            return self._events_dropped
 
     def entries(self) -> List[dict]:
         with self._mtx:
@@ -165,6 +203,8 @@ class Profiler:
             self._entries.clear()
             self._dropped = 0
             self._seq = 0
+            self._events.clear()
+            self._events_dropped = 0
             if capacity is not None:
                 self._capacity = max(1, int(capacity))
 

@@ -103,6 +103,20 @@ def safe_tpu_device(timeout: float = _PROBE_TIMEOUT_S):
         return None
 
 
+def clear_cache() -> None:
+    """Forget the cached liveness verdict (module global + env) so the next
+    ``tpu_alive`` re-probes.  The operator-facing device reprobe seam
+    (crypto/batch.reprobe(force=True)) uses this: a tunnel that came back
+    must be rediscoverable without a process restart.  Costly on a
+    still-dead tunnel (the next probe pays the full subprocess timeout), so
+    only explicit operator action clears the cache — the breaker-driven
+    automatic reprobe leaves it intact."""
+    global _verdict
+    with _lock:
+        _verdict = None
+        os.environ.pop("TM_AXON_ALIVE", None)
+
+
 def _reset_for_tests() -> None:
     global _verdict
     with _lock:

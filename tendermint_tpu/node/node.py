@@ -160,6 +160,13 @@ class Node(BaseService):
         from tendermint_tpu.libs.metrics import NodeMetrics
 
         self.metrics = NodeMetrics() if config.instrumentation.prometheus else None
+
+        # device dispatch guard: breaker thresholds, dispatch deadline and
+        # the silent-corruption audit rate come from the [verify] section
+        from tendermint_tpu.libs.breaker import configure_device_guard
+
+        configure_device_guard(config.verify)
+
         if self.metrics is not None:
             # slow-subscriber drop accounting (libs/pubsub.py)
             m = self.metrics

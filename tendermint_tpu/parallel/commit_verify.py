@@ -20,6 +20,7 @@ nil votes are a ``present`` mask so the quorum math stays branch-free.
 
 from __future__ import annotations
 
+import threading
 import time
 from dataclasses import dataclass
 from typing import Optional, Sequence, Tuple
@@ -57,6 +58,11 @@ class CommitWindow:
     present: np.ndarray  # (H, V) bool — vote present AND host-side prechecks ok
     power: np.ndarray  # (H, V) int64 voting power (0 where absent)
     pack_seconds: float = 0.0  # host pack wall time (cost ledger)
+    # raw signature columns (coords (n,2) int64, pubs, msgs, sigs) — kept so
+    # a failed/quarantined device dispatch can complete bit-identically on
+    # the host oracle, and so the corruption audit has something to check
+    # against.  References into the caller's vote tuples, not copies.
+    raw: Optional[tuple] = None
 
     @property
     def shape(self):
@@ -115,6 +121,7 @@ def pack_commit_window(
         win.power[hs, vs] = np.where(
             valid, np.asarray(pows_l, dtype=np.int64), 0
         )
+        win.raw = (hv, pubs_l, msgs_l, sigs_l)
     win.pack_seconds = time.perf_counter() - t_pack
     return win
 
@@ -163,11 +170,157 @@ def _pad_to(a: np.ndarray, h: int, v: int) -> np.ndarray:
     return np.pad(a, pads)
 
 
+def _verify_window_host(
+    win: CommitWindow, total_power: int
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Bit-identical host completion of a packed window, from the retained
+    raw columns: same ok/tally/committed semantics as the device step
+    (accept/reject parity is the tests/test_ops_ed25519.py invariant)."""
+    from tendermint_tpu.crypto import ed25519 as _ed
+
+    H, V = win.shape
+    ok = np.zeros((H, V), dtype=bool)
+    if win.raw is not None:
+        coords, pubs_l, msgs_l, sigs_l = win.raw
+        if len(pubs_l):
+            res = np.fromiter(
+                (_ed.verify(p, m, s)
+                 for p, m, s in zip(pubs_l, msgs_l, sigs_l)),
+                dtype=bool, count=len(pubs_l),
+            )
+            ok[coords[:, 0], coords[:, 1]] = res
+    ok &= win.present
+    tally = np.sum(np.where(ok, win.power, 0), axis=-1).astype(np.int64)
+    committed = tally * 3 > np.int64(total_power) * 2
+    return ok, tally, committed
+
+
+def _audit_window_verdict(win: CommitWindow, ok: np.ndarray) -> bool:
+    """Silent-corruption audit over a window verdict: k seeded-sampled
+    present lanes re-verified on the host oracle.  True iff any disagrees."""
+    import math
+    import random
+
+    from tendermint_tpu.crypto import ed25519 as _ed
+    from tendermint_tpu.libs.breaker import guard_config
+
+    cfg = guard_config()
+    rate = cfg.audit_sample_rate
+    if rate <= 0 or win.raw is None:
+        return False
+    coords, pubs_l, msgs_l, sigs_l = win.raw
+    cand = [
+        i for i in range(len(pubs_l))
+        if win.present[coords[i, 0], coords[i, 1]]
+    ]
+    if not cand:
+        return False
+    global _audit_seq
+    with _audit_mtx:
+        seq = _audit_seq
+        _audit_seq += 1
+    k = min(len(cand), max(1, int(math.ceil(len(cand) * rate))))
+    rng = random.Random((cfg.audit_seed << 20) ^ seq)
+    lanes = rng.sample(cand, k)
+    bad = []
+    for i in lanes:
+        host_ok = _ed.verify(pubs_l[i], msgs_l[i], sigs_l[i])
+        if host_ok != bool(ok[coords[i, 0], coords[i, 1]]):
+            bad.append(i)
+    try:
+        m = get_verify_metrics()
+        if k - len(bad):
+            m.device_audit.add(float(k - len(bad)), ("ok",))
+        if bad:
+            m.device_audit.add(float(len(bad)), ("mismatch",))
+    except Exception:
+        pass
+    if bad:
+        try:
+            get_profiler().record_event(
+                "audit_mismatch", backend="window", sampled=k,
+                mismatches=len(bad), lanes=bad[:8],
+            )
+        except Exception:
+            pass
+    return bool(bad)
+
+
+_audit_mtx = threading.Lock()
+_audit_seq = 0
+
+
+def _note_fallback(reason: str, win: CommitWindow) -> None:
+    try:
+        get_verify_metrics().device_fallback.add(1.0, (reason,))
+    except Exception:
+        pass
+    try:
+        get_profiler().record_event(
+            "device_fallback", reason=reason, backend="window",
+            heights=win.shape[0],
+        )
+    except Exception:
+        pass
+
+
 def verify_commit_window(
     win: CommitWindow, total_power: int, mesh=None
 ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
     """Verify a packed window; returns (ok (H,V) bool, tally (H,) int64,
-    committed (H,) bool).  With a 2-D mesh, shards heights × validators."""
+    committed (H,) bool).  With a 2-D mesh, shards heights × validators.
+
+    The device dispatch runs behind the fault guard (libs/breaker.py):
+    breaker gate, supervised deadline, one bounded retry, then bit-identical
+    completion on the host oracle from the window's retained raw columns;
+    an audit mismatch quarantines the device path."""
+    from tendermint_tpu.libs import breaker as _brk
+
+    br = _brk.get_device_breaker()
+    cfg = _brk.guard_config()
+    if win.raw is None:
+        # no raw columns (hand-built window): nothing to fall back to or
+        # audit against — dispatch unguarded as before
+        return _verify_window_device(win, total_power, mesh)
+    if not br.allow():
+        reason = (
+            "quarantined" if br.state == _brk.QUARANTINED else "breaker_open"
+        )
+        _note_fallback(reason, win)
+        return _verify_window_host(win, total_power)
+    attempts = 0
+    while True:
+        try:
+            out = _brk.supervised_call(
+                lambda: _verify_window_device(win, total_power, mesh),
+                cfg.dispatch_deadline, name="commit-window",
+            )
+        except Exception as e:
+            reason = (
+                "timeout" if isinstance(e, _brk.DispatchTimeout) else "error"
+            )
+            br.record_failure(reason)
+            attempts += 1
+            if attempts <= cfg.retries and br.allow():
+                try:
+                    get_verify_metrics().device_retries.add(1.0)
+                except Exception:
+                    pass
+                continue
+            _note_fallback(reason, win)
+            return _verify_window_host(win, total_power)
+        if _audit_window_verdict(win, out[0]):
+            br.quarantine("audit_mismatch:window")
+            _note_fallback("audit_mismatch", win)
+            return _verify_window_host(win, total_power)
+        br.record_success()
+        return out
+
+
+def _verify_window_device(
+    win: CommitWindow, total_power: int, mesh=None
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """The raw (unguarded) device dispatch."""
     H, V = win.shape
     ph, pv = H, V
     if mesh is not None:

@@ -46,7 +46,9 @@ no quorum math lives in the callers anymore.
 
 from __future__ import annotations
 
+import math
 import queue
+import random
 import threading
 import time
 from dataclasses import dataclass
@@ -485,6 +487,144 @@ def _execute_host(plan: WindowPlan, verifier=None) -> WindowVerdict:
     )
 
 
+# ---------------------------------------------------------------------------
+# Fault-tolerant device dispatch (libs/breaker.py)
+# ---------------------------------------------------------------------------
+
+# chaos/test seam: when set, replaces the raw device executor so seeded
+# fail/hang/corrupt schedules (sim/faults.FaultyDevice) can drive the guard
+_device_executor = None
+
+_audit_mtx = threading.Lock()
+_audit_seq = 0
+
+
+def set_device_executor(fn=None) -> None:
+    """Install a replacement for `_execute_device` (same signature); None
+    restores the real one.  The guard — breaker, deadline, retry, audit,
+    host fallback — wraps whatever is installed, which is exactly what
+    makes the fault path chaos-testable."""
+    global _device_executor
+    _device_executor = fn
+
+
+def _note_device_fallback(reason: str, plan: WindowPlan) -> None:
+    try:
+        get_verify_metrics().device_fallback.add(1.0, (reason,))
+    except Exception:
+        pass
+    try:
+        get_profiler().record_event(
+            "device_fallback", reason=reason, backend="planner",
+            heights=plan.H, lanes=plan.n_lanes,
+        )
+    except Exception:
+        pass
+
+
+def _audit_device_verdict(plan: WindowPlan, verdict: WindowVerdict) -> bool:
+    """Silent-corruption audit: re-verify k seeded-sampled wellformed lanes
+    on the host oracle and compare with the device verdict.  True iff any
+    lane disagrees.  Only wellformed lanes are sampled — unshaped lanes
+    auto-fail on the device by construction, so they carry no signal about
+    kernel correctness."""
+    from tendermint_tpu.libs.breaker import guard_config
+
+    cfg = guard_config()
+    rate = cfg.audit_sample_rate
+    if rate <= 0 or plan.n_lanes == 0:
+        return False
+    cand = np.flatnonzero(plan.wellformed)
+    if cand.size == 0:
+        return False
+    global _audit_seq
+    with _audit_mtx:
+        seq = _audit_seq
+        _audit_seq += 1
+    k = min(int(cand.size), max(1, int(math.ceil(cand.size * rate))))
+    rng = random.Random((cfg.audit_seed << 20) ^ seq)
+    lanes = rng.sample([int(j) for j in cand], k)
+    from tendermint_tpu.crypto import ed25519 as _ed
+
+    bad = []
+    for j in lanes:
+        pb = _pub_bytes(plan.pubs[j])
+        host_ok = _ed.verify(pb, plan.msgs[j], plan.sigs[j])
+        dev_ok = bool(verdict.ok[plan.coords[j, 0], plan.coords[j, 1]])
+        if host_ok != dev_ok:
+            bad.append(j)
+    try:
+        m = get_verify_metrics()
+        if k - len(bad):
+            m.device_audit.add(float(k - len(bad)), ("ok",))
+        if bad:
+            m.device_audit.add(float(len(bad)), ("mismatch",))
+    except Exception:
+        pass
+    if bad:
+        try:
+            get_profiler().record_event(
+                "audit_mismatch", backend="planner", heights=plan.H,
+                sampled=k, mismatches=len(bad), lanes=bad[:8],
+            )
+        except Exception:
+            pass
+    return bool(bad)
+
+
+def _execute_device_guarded(
+    plan: WindowPlan, mesh=None, verifier=None
+) -> WindowVerdict:
+    """`_execute_device` behind the full dispatch guard: breaker gate →
+    supervised deadline → bounded retry → bit-identical completion via
+    `_execute_host`, plus the silent-corruption audit whose mismatch
+    quarantines the device path (operator reset required).  A caller can
+    always rely on getting a verdict back — never a device exception, a
+    hang, or an unaudited device result."""
+    from tendermint_tpu.libs import breaker as _brk
+
+    br = _brk.get_device_breaker()
+    cfg = _brk.guard_config()
+    exe = _device_executor if _device_executor is not None else _execute_device
+    if not br.allow():
+        reason = (
+            "quarantined" if br.state == _brk.QUARANTINED else "breaker_open"
+        )
+        _note_device_fallback(reason, plan)
+        return _execute_host(plan, verifier=verifier)
+    attempts = 0
+    while True:
+        try:
+            verdict = _brk.supervised_call(
+                lambda: exe(plan, mesh), cfg.dispatch_deadline,
+                name="planner-window",
+            )
+        except Exception as e:
+            reason = (
+                "timeout" if isinstance(e, _brk.DispatchTimeout) else "error"
+            )
+            br.record_failure(reason)
+            attempts += 1
+            if attempts <= cfg.retries and br.allow():
+                try:
+                    get_verify_metrics().device_retries.add(1.0)
+                except Exception:
+                    pass
+                continue
+            _note_device_fallback(reason, plan)
+            return _execute_host(plan, verifier=verifier)
+        if _audit_device_verdict(plan, verdict):
+            # the device returned verdicts that disagree with the host
+            # oracle — a safety bug, not a perf bug.  Latch it out of
+            # service and recompute the whole window on the host; the
+            # sampled lanes say nothing about the unsampled ones.
+            br.quarantine("audit_mismatch:planner")
+            _note_device_fallback("audit_mismatch", plan)
+            return _execute_host(plan, verifier=verifier)
+        br.record_success()
+        return verdict
+
+
 def execute_plan(
     plan: WindowPlan, mesh=None, verifier=None, use_device: Optional[bool] = None
 ) -> WindowVerdict:
@@ -495,7 +635,7 @@ def execute_plan(
     if use_device is None:
         use_device = mesh is not None
     if use_device and plan.all_ed25519():
-        return _execute_device(plan, mesh=mesh)
+        return _execute_device_guarded(plan, mesh=mesh, verifier=verifier)
     return _execute_host(plan, verifier=verifier)
 
 
@@ -559,6 +699,30 @@ class WindowPipeline:
         self.use_device = use_device
         self.prefetch = max(1, prefetch)
 
+    def _execute_one(self, plan: WindowPlan) -> WindowVerdict:
+        """One window's dispatch.  A device-path exception that somehow
+        escapes the guard (a guard bug, a raw executor installed without
+        it) must not abandon the queued and in-flight windows behind it:
+        this window completes bit-identically on the host and the stream
+        keeps going.  Host-path exceptions re-raise — they are input bugs,
+        not device faults, and retrying the same path cannot help."""
+        try:
+            return execute_plan(
+                plan, mesh=self.mesh, verifier=self.verifier,
+                use_device=self.use_device,
+            )
+        except Exception:
+            dev = self.use_device if self.use_device is not None else (
+                self.mesh is not None
+            )
+            if not (dev and plan.all_ed25519()):
+                raise
+            from tendermint_tpu.libs.breaker import get_device_breaker
+
+            get_device_breaker().record_failure("pipeline_error")
+            _note_device_fallback("pipeline_error", plan)
+            return _execute_host(plan, verifier=self.verifier)
+
     def run(
         self, specs: Iterable[Tuple[Sequence, Sequence, Sequence]]
     ) -> Iterator[WindowVerdict]:
@@ -612,10 +776,7 @@ class WindowPipeline:
                     return
                 if kind == "err":
                     raise item
-                yield execute_plan(
-                    item, mesh=mesh, verifier=self.verifier,
-                    use_device=use_device,
-                )
+                yield self._execute_one(item)
         finally:
             # generator closed/abandoned (GeneratorExit, consumer raise,
             # normal end): release the worker promptly — signal stop, then

@@ -149,6 +149,15 @@ class HTTPClient:
             params["capacity"] = capacity
         return self.call("flight_reset", **params)
 
+    def dump_device_health(self) -> dict:
+        return self.call("dump_device_health")
+
+    def device_breaker_reset(self, reprobe: Optional[bool] = None) -> dict:
+        return self.call(
+            "device_breaker_reset",
+            **({"reprobe": reprobe} if reprobe is not None else {}),
+        )
+
     def unconfirmed_txs(self, limit: int = 30) -> dict:
         return self.call("unconfirmed_txs", limit=limit)
 

@@ -634,6 +634,54 @@ class RPCEnv:
             "total_entries": total,
             "truncated": truncated,
             "dropped": p.dropped,
+            # health events (breaker transitions, audits, fallbacks) ride
+            # their own ring — high-churn dispatch entries can't evict them
+            "events": p.events(),
+            "events_dropped": p.events_dropped,
+        }
+
+    def dump_device_health(self) -> dict:
+        """Device verify-path health: circuit-breaker snapshot (state,
+        counters, transition history), guard config knobs, the installed
+        default verifier's identity, and the profiler's breaker/audit/
+        fallback event ring (libs/breaker.py).  Gated like dump_trace —
+        device health and timings are operator telemetry."""
+        self._require_unsafe()
+        from tendermint_tpu.crypto.batch import verifier_info
+        from tendermint_tpu.libs.breaker import get_device_breaker, guard_config
+        from tendermint_tpu.libs.profile import get_profiler
+
+        p = get_profiler()
+        events = [
+            e for e in p.events()
+            if e["kind"] in ("breaker", "audit_mismatch", "device_fallback")
+        ]
+        return {
+            "breaker": get_device_breaker().snapshot(),
+            "config": guard_config().as_dict(),
+            "verifier": verifier_info(),
+            "events": events,
+            "events_dropped": p.events_dropped,
+        }
+
+    def device_breaker_reset(self, reprobe=None) -> dict:
+        """Operator reset of the device circuit breaker — the ONLY way out
+        of the quarantined state (a device that disagreed with the host
+        oracle must not be re-admitted by timers).  reprobe=true also drops
+        the lazy default verifier and the TPU liveness cache so device
+        selection reruns from scratch (pays a full probe timeout if the
+        device is still dead)."""
+        self._require_unsafe()
+        from tendermint_tpu.crypto import batch as _batch
+        from tendermint_tpu.libs.breaker import get_device_breaker
+
+        br = get_device_breaker()
+        br.reset()
+        if reprobe is not None and bool(reprobe):
+            _batch.reprobe(force=True)
+        return {
+            "breaker": br.snapshot(),
+            "verifier": _batch.verifier_info(),
         }
 
     def dump_flight(self, limit=None) -> dict:

@@ -10,6 +10,7 @@ timed fault-schedule DSL; `scenarios.py` the named scenario matrix that
 
 from tendermint_tpu.sim.byzantine import EquivocatingPV
 from tendermint_tpu.sim.clock import SimClock
+from tendermint_tpu.sim.faults import FaultyDevice
 from tendermint_tpu.sim.node import SimNode, build_sim_net
 from tendermint_tpu.sim.scenario import (
     FaultOp,
@@ -24,6 +25,7 @@ from tendermint_tpu.sim.simnet import LinkPolicy, SimNet
 __all__ = [
     "EquivocatingPV",
     "FaultOp",
+    "FaultyDevice",
     "LinkPolicy",
     "SCENARIOS",
     "Scenario",

@@ -65,20 +65,42 @@ class SimNode:
 
     def __init__(self, index: int, node_id: str, doc: GenesisDoc, pv,
                  fabric: SimNet, config=None, app=None,
-                 clock: Optional[SimClock] = None):
+                 clock: Optional[SimClock] = None,
+                 state_db=None, block_store=None,
+                 wal_path: Optional[str] = None, handshake: bool = False):
         self.index = index
         self.node_id = node_id
+        self.doc = doc
         self.pv = pv
+        self.fabric = fabric
         self.clock = clock or SimClock()
-        cfg = config or test_config()
+        self.config = cfg = config or test_config()
+        self.wal_path = wal_path
 
-        st = state_from_genesis(doc)
-        self.state_db = MemDB()
-        sm_store.save_state(self.state_db, st)
+        # crash_restart hands back the dead node's stores: rebuild state
+        # from the DB instead of genesis, and let the ABCI handshake
+        # re-apply any blocks the (fresh) app is missing.
+        if state_db is not None:
+            self.state_db = state_db
+            st = sm_store.load_state_from_db_or_genesis(self.state_db, doc)
+        else:
+            st = state_from_genesis(doc)
+            self.state_db = MemDB()
+            sm_store.save_state(self.state_db, st)
+        self.block_store = (block_store if block_store is not None
+                            else BlockStore(MemDB()))
 
         self.app = app or KVStoreApp()
         self.conn = MultiAppConn(LocalClientCreator(self.app))
         self.conn.start()
+        self.handshake_blocks = 0
+        if handshake:
+            from tendermint_tpu.consensus.replay import Handshaker
+
+            hs = Handshaker(self.state_db, st, self.block_store, doc)
+            st = hs.handshake(self.conn)
+            sm_store.save_state(self.state_db, st)
+            self.handshake_blocks = hs.n_blocks
         # per-node registry so scenarios can assert on QoS/lane counters
         self.metrics = NodeMetrics()
         self.mempool = Mempool(
@@ -92,7 +114,6 @@ class SimNode:
             recheck_batch=cfg.mempool.recheck_batch,
         )
         self.evpool = EvidencePool(self.state_db, MemDB(), st.copy())
-        self.block_store = BlockStore(MemDB())
 
         self.bus = EventBus()
         self.bus.start()
@@ -100,9 +121,14 @@ class SimNode:
             self.state_db, self.conn.consensus, self.mempool, self.evpool,
             self.bus,
         )
+        wal = None
+        if wal_path:
+            from tendermint_tpu.consensus.wal import WAL
+
+            wal = WAL(wal_path, metrics=self.metrics)
         self.cs = ConsensusState(
             cfg.consensus, st.copy(), block_exec, self.block_store,
-            self.mempool, self.evpool,
+            self.mempool, self.evpool, wal=wal,
         )
         self.cs.set_event_bus(self.bus)
         self.cs.set_priv_validator(pv)
@@ -144,6 +170,17 @@ class SimNode:
             pass
         try:
             self.bus.stop()
+        except Exception:
+            pass
+
+    def crash(self) -> None:
+        """Kill the node mid-flight, keeping its durable state (state_db,
+        block_store, WAL file) for a replacement SimNode to rebuild from.
+        Every WAL write already flushed (see WAL.write), so the file on
+        disk is exactly what a kill -9 would leave behind."""
+        self.stop()
+        try:
+            self.conn.stop()
         except Exception:
             pass
 

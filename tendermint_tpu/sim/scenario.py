@@ -35,7 +35,9 @@ class FaultOp:
     """One timed operation.  `at_s` is seconds after net start."""
 
     at_s: float
-    op: str  # policy|clear_policies|partition|heal|silence|unsilence|skew|tx|mark
+    # policy|clear_policies|partition|heal|silence|unsilence|skew|tx|mark|
+    # crash_restart
+    op: str
     kwargs: dict = field(default_factory=dict)
 
 
@@ -102,6 +104,12 @@ class ScenarioRun:
         self.marks: Dict[str, dict] = {}
         self.failures: List[str] = []
         self.t0 = 0.0
+        self._defers: List[Callable[[], None]] = []
+
+    def defer(self, fn: Callable[[], None]) -> None:
+        """Register a cleanup to run after every node has stopped (LIFO) —
+        scenarios use it for tmpdirs and process-global verifier swaps."""
+        self._defers.append(fn)
 
     def heights(self) -> List[int]:
         return [n.height for n in self.nodes]
@@ -159,10 +167,42 @@ class ScenarioRun:
                     self.nodes[i].mempool.check_tx(kw["tx"])
                 except Exception:
                     pass  # duplicate/rejected on some nodes is fine
+        elif op.op == "crash_restart":
+            self.crash_restart(kw["node"], fresh_app=kw.get("fresh_app", True))
         elif op.op == "mark":
             self.mark(kw["label"])
         else:
             raise ValueError(f"unknown fault op {op.op!r}")
+
+    def crash_restart(self, i: int, fresh_app: bool = True):
+        """Kill node `i` and rebuild it from its surviving stores.  The
+        replacement loads state from the old state_db, replays the WAL
+        into the round state, runs the ABCI handshake (re-applying every
+        committed block into a fresh app when `fresh_app`), and rejoins
+        the fabric under the same node id.  Replaces ``self.nodes[i]`` in
+        place — run_scenario's final stop loop sees the new node."""
+        from tendermint_tpu.sim.node import SimNode
+
+        old = self.nodes[i]
+        old.crash()
+        node = SimNode(
+            index=old.index, node_id=old.node_id, doc=old.doc, pv=old.pv,
+            fabric=self.fabric, config=old.config,
+            app=None if fresh_app else old.app, clock=old.clock,
+            state_db=old.state_db, block_store=old.block_store,
+            wal_path=old.wal_path, handshake=True,
+        )
+        # Re-wire the mesh from the new switch's side; the other nodes'
+        # existing InProcPeer handles stay valid (the fabric routes by
+        # node id, and register() re-points the id at the new switch).
+        for other in self.nodes:
+            if other is not old:
+                node.switch.connect(other.node_id)
+                other.switch.connect(node.node_id)  # idempotent
+        node.start()
+        self.nodes[i] = node
+        self.mark(f"crash_restart:{old.node_id}")
+        return node
 
 
 def _safety_failures(run: ScenarioRun) -> List[str]:
@@ -267,6 +307,11 @@ def run_scenario(scenario: Scenario, seed: Optional[int] = None) -> ScenarioResu
         for node in nodes:
             node.stop()
         fabric.stop()
+        for fn in reversed(run._defers):
+            try:
+                fn()
+            except Exception:
+                pass
 
     return ScenarioResult(
         name=scenario.name,

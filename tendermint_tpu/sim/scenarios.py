@@ -29,6 +29,15 @@ silence_watchdog      >1/3 power silenced ⇒ watchdog stall report names
 mempool_flood         one node spams ~10x the per-peer QoS rate ⇒ honest
                       priority txs still commit, mempools stay bounded,
                       drops land in tendermint_mempool_qos_* counters
+device_flap           FaultyDevice behind the guarded verifier fails, hangs,
+                      then silently corrupts ⇒ breaker walks closed→open→
+                      half_open→closed, then quarantines on the audit
+                      mismatch; the chain never stops committing and no
+                      wrong verdict escapes
+crash_restart         one node killed mid-height, rebuilt from its stores +
+                      WAL ⇒ WAL messages replay, the ABCI handshake
+                      re-applies committed blocks into the fresh app, and
+                      the node catches back up to the chain
 ====================  =====================================================
 """
 
@@ -492,6 +501,181 @@ def mempool_flood() -> Scenario:
     )
 
 
+def device_flap() -> Scenario:
+    """The guarded batch verifier's device backend fails, hangs, recovers,
+    then silently corrupts — mid-run, with consensus live on top of it.
+    The breaker must walk the whole state machine (open on errors, open on
+    timeouts, half-open probe, re-close, quarantine latch on the audit
+    mismatch, operator reset), verdicts must stay bit-identical to the
+    host path throughout (asserted indirectly: safety + uninterrupted
+    liveness), and the episode must land in the metric exposition."""
+
+    def setup(run: ScenarioRun) -> None:
+        from tendermint_tpu.crypto import batch as _batch
+        from tendermint_tpu.libs import breaker as _brk
+        from tendermint_tpu.sim.faults import FaultyDevice
+
+        # small backoffs so every transition fits a smoke-test budget;
+        # audit every lane so the first corrupt window is always caught
+        br = _brk.configure_device_guard(
+            breaker_threshold=3, breaker_backoff=0.2,
+            breaker_backoff_max=0.4, dispatch_deadline=0.3,
+            audit_sample_rate=1.0, retries=0,
+        )
+        prev = _batch.get_batch_verifier()  # host, pinned by build_sim_net
+        dev = FaultyDevice(_batch.HostBatchVerifier(),
+                           seed=run.scenario.seed, hang_s=1.0)
+        _batch.set_batch_verifier(_batch.GuardedBatchVerifier(dev, breaker=br))
+        run.device, run.breaker = dev, br
+        run.defer(_brk.reset_device_guard)
+        run.defer(lambda: _batch.set_batch_verifier(prev))
+
+    def drive(run: ScenarioRun) -> List[str]:
+        from tendermint_tpu.libs import breaker as _brk
+
+        failures = []
+        br, dev = run.breaker, run.device
+        if not run.wait_height(1, 30.0):
+            return [f"never warmed up: {run.heights()}"]
+
+        def phase(label: str, want_state: str, budget: float = 20.0,
+                  progress: int = 2) -> None:
+            run.mark(label)
+            if not run.wait_for(lambda: br.state == want_state, budget):
+                failures.append(
+                    f"{label}: breaker stuck in {br.state!r}, "
+                    f"wanted {want_state!r}"
+                )
+            h = max(run.heights())
+            if progress and not run.wait_height(h + progress, 45.0):
+                failures.append(
+                    f"{label}: chain stalled at {run.heights()} "
+                    f"(breaker {br.state})"
+                )
+
+        dev.fail_rate = 1.0          # crashing device -> open, host fallback
+        phase("fail", _brk.OPEN)
+        dev.fail_rate = 0.0          # recovery -> half-open probe re-closes
+        phase("recover_fail", _brk.CLOSED, progress=0)
+        dev.hang_rate = 1.0          # wedged device -> timeouts -> open again
+        phase("hang", _brk.OPEN)
+        dev.hang_rate = 0.0
+        phase("recover_hang", _brk.CLOSED, progress=0)
+        dev.corrupt_rate = 1.0       # silent corruption -> quarantine latch
+        phase("corrupt", _brk.QUARANTINED)
+        dev.corrupt_rate = 0.0
+        br.reset()                   # the operator runbook step
+        phase("operator_reset", _brk.CLOSED)
+        return failures
+
+    def check(run: ScenarioRun) -> List[str]:
+        from tendermint_tpu.libs.metrics import get_verify_metrics
+
+        failures = []
+        snap = run.breaker.snapshot()
+        walked = {(h["from"], h["to"]) for h in snap["history"]}
+        for want in [("closed", "open"), ("open", "half_open"),
+                     ("half_open", "closed"), ("closed", "quarantined"),
+                     ("quarantined", "closed")]:
+            if want not in walked:
+                failures.append(f"breaker never transitioned {want}: {walked}")
+        reasons = " ".join(h["reason"] for h in snap["history"])
+        if "timeout" not in reasons:
+            failures.append(f"no timeout-driven open in history: {reasons}")
+        dsnap = run.device.snapshot()
+        if dsnap["failures"] == 0 or dsnap["hangs"] == 0 \
+                or dsnap["corruptions"] == 0:
+            failures.append(f"fault injection never fired: {dsnap}")
+        text = get_verify_metrics().registry.expose_text()
+        for name in ("tendermint_verify_device_breaker_state",
+                     "tendermint_verify_device_fallback_total",
+                     "tendermint_verify_device_audit_total"):
+            if name not in text:
+                failures.append(f"{name} missing from metric exposition")
+        return failures
+
+    return Scenario(
+        name="device_flap",
+        description="device backend fails/hangs/corrupts mid-run; breaker "
+                    "walks its full state machine, consensus keeps "
+                    "committing on host fallback, audit quarantines the "
+                    "corruptor before a wrong verdict escapes",
+        seed=9,
+        timeout_s=180.0,
+        setup=setup,
+        drive=drive,
+        check=check,
+    )
+
+
+def crash_restart() -> Scenario:
+    """Kill one validator mid-height and rebuild it from its surviving
+    stores + WAL file: the replacement must replay WAL messages into the
+    round state, re-apply committed blocks into the fresh app over the
+    ABCI handshake, and catch back up to the live chain."""
+    import os
+    import shutil
+    import tempfile
+
+    VICTIM = 2
+
+    def setup(run: ScenarioRun) -> None:
+        from tendermint_tpu.sim.node import SimNode
+
+        tmp = tempfile.mkdtemp(prefix="tm-sim-crash-")
+        run.defer(lambda: shutil.rmtree(tmp, ignore_errors=True))
+        # rebuild the victim with a real on-disk WAL before anything
+        # starts (build_sim_net wires WAL-less nodes); peers' handles to
+        # the node id stay valid, the fabric just re-points the id
+        old = run.nodes[VICTIM]
+        old.crash()
+        node = SimNode(
+            index=old.index, node_id=old.node_id, doc=old.doc, pv=old.pv,
+            fabric=run.fabric, config=old.config, clock=old.clock,
+            wal_path=os.path.join(tmp, "cs.wal"),
+        )
+        for other in run.nodes:
+            if other is not old:
+                node.switch.connect(other.node_id)
+        run.nodes[VICTIM] = node
+
+    def drive(run: ScenarioRun) -> List[str]:
+        failures = []
+        if not run.wait_height(2, 45.0):
+            return [f"never warmed up: {run.heights()}"]
+        pre_crash = dict(run.nodes[VICTIM].committed_hashes())
+        node = run.crash_restart(VICTIM)
+        if node.handshake_blocks <= 0:
+            failures.append(
+                "ABCI handshake replayed no blocks into the fresh app"
+            )
+        if node.cs.wal_replayed <= 0:
+            failures.append("WAL replay re-fed no messages after the crash")
+        for h, hh in pre_crash.items():
+            if node.committed_hashes().get(h) != hh:
+                failures.append(
+                    f"restart lost/changed committed block at height {h}"
+                )
+        h = max(run.heights())
+        if not run.wait_for(lambda: node.height > h + 2, 60.0):
+            failures.append(
+                f"restarted node never rejoined: victim at {node.height}, "
+                f"net at {run.heights()}"
+            )
+        return failures
+
+    return Scenario(
+        name="crash_restart",
+        description="node killed mid-height, rebuilt from stores + WAL; "
+                    "WAL replay + ABCI handshake bring it back and it "
+                    "catches up to the chain",
+        seed=10,
+        timeout_s=180.0,
+        setup=setup,
+        drive=drive,
+    )
+
+
 SCENARIOS: Dict[str, Callable[[], Scenario]] = {
     "baseline_determinism": baseline_determinism,
     "partition_heal": partition_heal,
@@ -502,4 +686,6 @@ SCENARIOS: Dict[str, Callable[[], Scenario]] = {
     "equivocation": equivocation,
     "silence_watchdog": silence_watchdog,
     "mempool_flood": mempool_flood,
+    "device_flap": device_flap,
+    "crash_restart": crash_restart,
 }

@@ -80,6 +80,9 @@ class NodeMonitor:
         # liveness-watchdog columns (tendermint_consensus_stall*)
         self.stalls_total = 0
         self.stall_seconds = 0.0
+        # device-guard columns (tendermint_verify_device_*)
+        self.device_state = -1  # -1 unknown, else breaker gauge code
+        self.device_fallbacks = 0
         self._last_block_at: Optional[float] = None
         self._started = time.monotonic()
         self._online_time = 0.0
@@ -136,6 +139,13 @@ class NodeMonitor:
         self.stall_seconds = _sum_family(
             m, "tendermint_consensus_stall_seconds"
         )
+        if "tendermint_verify_device_breaker_state" in m:
+            self.device_state = int(
+                m["tendermint_verify_device_breaker_state"]
+            )
+        self.device_fallbacks = int(
+            _sum_family(m, "tendermint_verify_device_fallback_total")
+        )
 
     def _connect_ws(self) -> None:
         try:
@@ -187,6 +197,8 @@ class NodeMonitor:
             "traffic_bytes": self.traffic_bytes,
             "stalls_total": self.stalls_total,
             "stall_seconds": self.stall_seconds,
+            "device_state": self.device_state,
+            "device_fallbacks": self.device_fallbacks,
             "uptime_pct": self.uptime_pct,
         }
 
@@ -227,6 +239,17 @@ class NetworkMonitor:
             n.stop()
 
 
+# breaker gauge code -> DEVICE column label (libs/breaker.STATE_GAUGE)
+_DEVICE_LABEL = {0: "ok", 1: "OPEN", 2: "PROBE", 3: "QUAR"}
+
+
+def _fmt_device(state: int, fallbacks: int) -> str:
+    if state < 0:
+        return "-"
+    label = _DEVICE_LABEL.get(state, f"?{state}")
+    return f"{label}+fb{fallbacks}" if fallbacks else label
+
+
 def _fmt_bytes(n: float) -> str:
     for unit in ("B", "KB", "MB", "GB"):
         if n < 1024 or unit == "GB":
@@ -256,8 +279,8 @@ def main(argv=None) -> int:
                       f"({snap['num_online']}/{snap['num_nodes']} online, "
                       f"height {snap['max_height']})")
                 print(f"{'MONIKER':<16}{'HEIGHT':>8}{'INTERVAL':>10}"
-                      f"{'VERIFY':>9}{'TRAFFIC':>10}{'STALL':>9}"
-                      f"{'UPTIME':>8}  ADDR")
+                      f"{'VERIFY':>9}{'DEVICE':>10}{'TRAFFIC':>10}"
+                      f"{'STALL':>9}{'UPTIME':>8}  ADDR")
                 for n in snap["nodes"]:
                     if n["online"]:
                         suffix = ""
@@ -277,6 +300,7 @@ def main(argv=None) -> int:
                         f"{n['moniker']:<16}{n['height']:>8}"
                         f"{n['block_interval_ms']:>9}ms"
                         f"{n['verify_ms']:>7}ms"
+                        f"{_fmt_device(n['device_state'], n['device_fallbacks']):>10}"
                         f"{_fmt_bytes(n['traffic_bytes']):>10}"
                         f"{stall:>9}"
                         f"{n['uptime_pct']:>7}%  "

@@ -122,6 +122,30 @@ class TestHTTPClient:
         assert stop["was_tracing"] is True
         assert client.call("unsafe_stop_heap_profiler")["was_tracing"] is False
 
+    def test_device_health_routes(self, client):
+        from tendermint_tpu.libs import breaker as brk
+
+        try:
+            health = client.dump_device_health()
+            snap = health["breaker"]
+            assert snap["state"] in ("closed", "open", "half_open",
+                                     "quarantined")
+            assert "failures_total" in snap and "history" in snap
+            assert health["config"]["breaker_threshold"] >= 1
+            assert health["verifier"]["installed"] is True
+            assert isinstance(health["events"], list)
+
+            # quarantine the process breaker, then clear it over RPC —
+            # the operator runbook for an audit_mismatch latch
+            brk.get_device_breaker().quarantine("audit_mismatch:test")
+            health = client.dump_device_health()
+            assert health["breaker"]["state"] == "quarantined"
+            res = client.device_breaker_reset()
+            assert res["breaker"]["state"] == "closed"
+            assert brk.get_device_breaker().state == brk.CLOSED
+        finally:
+            brk.reset_device_guard()
+
     def test_dial_routes_require_switch(self, client):
         # live_node runs without p2p; the route must gate cleanly, not crash
         with pytest.raises(RPCClientError):

@@ -239,6 +239,21 @@ class TestEvidenceEndToEnd:
 
 
 # ---------------------------------------------------------------------------
+# end-to-end: crash + durable-store restart (WAL replay, ABCI handshake)
+# ---------------------------------------------------------------------------
+
+
+class TestCrashRestart:
+    def test_crash_restart_scenario(self):
+        """Victim killed mid-height rebuilds from its surviving state db,
+        block store and WAL, replays into the round state, re-applies the
+        chain into a fresh app via the handshake, and rejoins consensus."""
+        result = run_scenario(SCENARIOS["crash_restart"]())
+        assert result.ok, f"seed={result.seed} failures={result.failures}"
+        assert any(k.startswith("crash_restart:") for k in result.marks)
+
+
+# ---------------------------------------------------------------------------
 # slow tier: the full matrix + determinism, same coverage as chaos-smoke
 # ---------------------------------------------------------------------------
 
