@@ -3,9 +3,9 @@
 PYTHON ?= python
 
 .PHONY: test test_slow test_sanitizers bench bench-local bench_fastsync \
-        planner-bench bench_secp bench_multisig mempool-bench metrics-lint \
-        bench-check statesync-smoke flight-smoke chaos-smoke localnet-start \
-        localnet-stop build-docker-localnode
+        planner-bench pallas-bench bench_secp bench_multisig mempool-bench \
+        metrics-lint bench-check statesync-smoke flight-smoke chaos-smoke \
+        localnet-start localnet-stop build-docker-localnode
 
 test:
 	$(PYTHON) -m pytest tests/ -q
@@ -31,6 +31,19 @@ bench_fastsync:
 # verification-planner occupancy/throughput on the ragged valset workload
 planner-bench:
 	JAX_PLATFORMS=cpu $(PYTHON) scripts/bench_fastsync.py --ragged-valsets
+
+# batched-verify throughput with the selected limb multiplier
+# (FE_BACKEND=vpu|mxu|mxu16); appends a round under build/pallas_bench and
+# gates ed25519_sigs_per_s (higher-is-better) against the previous round.
+# Uses the Pallas kernel when the TPU tunnel is up, else the XLA kernel on
+# the local backend — end-to-end runnable on JAX_PLATFORMS=cpu.
+FE_BACKEND ?= vpu
+pallas-bench:
+	$(PYTHON) scripts/profile_pallas.py \
+	  --fe-backend $(FE_BACKEND) --round-dir build/pallas_bench \
+	  --metrics-out build/pallas_bench/verify_metrics.prom $(ARGS)
+	$(PYTHON) scripts/bench_check.py --dir build/pallas_bench \
+	  --metric "ed25519_sigs_per_s$(if $(filter-out vpu,$(FE_BACKEND)),_$(FE_BACKEND)):0.25:higher"
 
 bench_secp:
 	$(PYTHON) scripts/bench_secp.py 1024

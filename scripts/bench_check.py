@@ -40,6 +40,8 @@ DEFAULT_METRIC = "fastsync_blocks_per_s"
 DEFAULT_METRICS = [
     DEFAULT_METRIC,
     "mempool_checktx_per_s:0.25:higher",
+    # batched-verify headline (scripts/profile_pallas.py / make pallas-bench)
+    "ed25519_sigs_per_s:0.25:higher",
 ]
 DEFAULT_THRESHOLD = 0.20
 

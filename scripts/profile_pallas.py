@@ -8,14 +8,33 @@ Emits JSON lines (captured into BENCH_LOCAL.md by scripts/bench_ledger.py):
                          separate the per-window slope from the fixed cost
                          (per-signature table build + fe_inv + canonical
                          compare), attributing the ladder milliseconds
+  pallas_ladder_window_slope / pallas_ladder_fixed
+                       — the w1/w16 least-cost split itself: slope is the
+                         marginal cost of one Straus window (where the limb
+                         multiplier lives — the VPU-vs-MXU comparison row),
+                         fixed is table build + fe_inv + canonical compare
   pallas_host_packing  — host-side packing with a warm decompression cache
+  ed25519_sigs_per_s   — headline throughput (gated by scripts/bench_check.py)
 
-Exits 0 with a note (and no JSON) when the TPU tunnel is down — the probe
-runs in a subprocess so a dead tunnel cannot hang this script
-(libs/tpu_probe).  PERF.md holds the matching op-count model.
+`--fe-backend {vpu,mxu,mxu16}` selects the limb multiplier ([verify]
+fe_backend); with a non-default backend every metric name is suffixed
+``_<backend>`` so BENCH_LOCAL.md keeps one row per backend.
+
+Without a TPU the Pallas stage split is unmeasurable (interpret mode is
+minutes per call), so the script degrades to the XLA kernel on the local
+backend — slower, but it keeps ``make pallas-bench`` producing a real
+``ed25519_sigs_per_s`` round end-to-end on JAX_PLATFORMS=cpu.
+
+`--round-dir DIR` appends a BENCH_rNN.json round (same schema as the
+committed driver ledger) under DIR for scripts/bench_check.py to gate;
+`--metrics-out PATH` snapshots the verify metric families.  PERF.md holds
+the matching op-count model.
 """
+import argparse
+import glob
 import json
 import os
+import re
 import sys
 import time
 
@@ -23,15 +42,13 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np
 
-from tendermint_tpu.libs.tpu_probe import tpu_alive
+from tendermint_tpu.libs.tpu_probe import pin_cpu_platform, tpu_alive
 
 N = 10_000
+N_CPU = 64  # XLA-on-CPU fallback: jit compile alone is minutes at 10k
 MSG_LEN = 110
 
-
-def _emit(metric, ms):
-    print(json.dumps({"metric": metric, "value": round(ms, 3), "unit": "ms"}),
-          flush=True)
+_emitted = {}
 
 
 def _median_ms(fn, reps=5):
@@ -43,35 +60,38 @@ def _median_ms(fn, reps=5):
     return float(np.median(ts)) * 1e3
 
 
-def main():
-    if not tpu_alive():
-        print("# TPU tunnel is down — no device profile this run",
-              file=sys.stderr)
-        return 0
-
-    import jax
-    import jax.numpy as jnp
-
+def _make_corpus(n):
     from tendermint_tpu.crypto import ed25519 as ed
-    from tendermint_tpu.ops import ed25519_pallas as pk
 
     rng = np.random.default_rng(42)
-    seeds = rng.bytes(32 * N)
-    pubs = np.zeros((N, 32), np.uint8)
-    sigs = np.zeros((N, 64), np.uint8)
+    seeds = rng.bytes(32 * n)
+    pubs = np.zeros((n, 32), np.uint8)
+    sigs = np.zeros((n, 64), np.uint8)
     msgs = []
-    for i in range(N):
+    for i in range(n):
         priv = ed.gen_privkey(seeds[32 * i : 32 * (i + 1)])
         msg = bytes([i & 0xFF, (i >> 8) & 0xFF]) * (MSG_LEN // 2)
         pubs[i] = np.frombuffer(priv[32:], np.uint8)
         sigs[i] = np.frombuffer(ed.sign(priv, msg), np.uint8)
         msgs.append(msg)
+    return pubs, msgs, sigs
 
+
+def _profile_pallas(emit, fe_backend):
+    import jax
+    import jax.numpy as jnp
+
+    from tendermint_tpu.ops import ed25519_pallas as pk
+
+    pubs, msgs, sigs = _make_corpus(N)
     print("# devices:", jax.devices(), file=sys.stderr)
 
-    ok = pk.verify_batch(pubs, msgs, sigs)  # warm (compile + upload)
+    ok = pk.verify_batch(pubs, msgs, sigs, fe_backend=fe_backend)  # warm
     assert ok.all()
-    _emit("pallas_e2e_10k", _median_ms(lambda: pk.verify_batch(pubs, msgs, sigs)))
+    e2e_ms = _median_ms(
+        lambda: pk.verify_batch(pubs, msgs, sigs, fe_backend=fe_backend)
+    )
+    emit("pallas_e2e_10k", e2e_ms)
 
     # stage split: host packing vs prologue vs ladder
     neg_ax, ay, _valid = pk._decompress_valset(pubs)
@@ -98,18 +118,18 @@ def main():
     prologue = jax.jit(lambda mw, sw: pk._prologue_call(mw, sw))
     ladder = jax.jit(
         lambda nx, ayy, digs, digh, rl, rs: pk._ladder_call(
-            nx, ayy, digs, digh, rl, rs
+            nx, ayy, digs, digh, rl, rs, fe_backend=fe_backend
         )
     )
 
     digs, digh, rlimb, rsign = jax.block_until_ready(prologue(msgw_d, sigw_d))
     jax.block_until_ready(ladder(negax_d, ay_d, digs, digh, rlimb, rsign))
 
-    _emit(
+    emit(
         "pallas_prologue_10k",
         _median_ms(lambda: jax.block_until_ready(prologue(msgw_d, sigw_d))),
     )
-    _emit(
+    emit(
         "pallas_ladder_10k",
         _median_ms(
             lambda: jax.block_until_ready(
@@ -122,25 +142,30 @@ def main():
     # from the digit rows, so short digit arrays time the same kernel with
     # fewer windows.  cost(nwin) ≈ fixed (table build + fe_inv + canonical
     # compare) + slope·nwin; see PERF.md for the matching op counts.
+    w_ms = {}
     for nwin in (1, 16):
         digs_n = digs[:nwin]
         digh_n = digh[:nwin]
         lad_n = jax.jit(
             lambda nx, ayy, dg, dh, rl, rs: pk._ladder_call(
-                nx, ayy, dg, dh, rl, rs
+                nx, ayy, dg, dh, rl, rs, fe_backend=fe_backend
             )
         )
         jax.block_until_ready(
             lad_n(negax_d, ay_d, digs_n, digh_n, rlimb, rsign)
         )
-        _emit(
-            f"pallas_ladder_w{nwin}",
-            _median_ms(
-                lambda: jax.block_until_ready(
-                    lad_n(negax_d, ay_d, digs_n, digh_n, rlimb, rsign)
-                )
-            ),
+        w_ms[nwin] = _median_ms(
+            lambda: jax.block_until_ready(
+                lad_n(negax_d, ay_d, digs_n, digh_n, rlimb, rsign)
+            )
         )
+        emit(f"pallas_ladder_w{nwin}", w_ms[nwin])
+
+    # the per-stage VPU/MXU comparison row: slope isolates the windowed
+    # point ops (where fe_mul lives), fixed the backend-invariant epilogue
+    slope = (w_ms[16] - w_ms[1]) / 15.0
+    emit("pallas_ladder_window_slope", slope)
+    emit("pallas_ladder_fixed", max(w_ms[1] - slope, 0.0))
 
     def _pack():
         pk._decompress_valset(pubs)
@@ -151,7 +176,104 @@ def main():
         mw = padded2.reshape(b, -1, 4)[:, :, ::-1].reshape(b, -1)
         np.ascontiguousarray(mw).view("<u4").astype(np.uint32)
 
-    _emit("pallas_host_packing", _median_ms(_pack))
+    emit("pallas_host_packing", _median_ms(_pack))
+    return N, e2e_ms, "pallas"
+
+
+def _profile_xla_fallback(emit, fe_backend):
+    from tendermint_tpu.ops import ed25519_verify as xk
+
+    pubs, msgs, sigs = _make_corpus(N_CPU)
+    ok = xk.verify_batch(pubs, msgs, sigs, fe_backend=fe_backend)  # compile
+    assert ok.all()
+    e2e_ms = _median_ms(
+        lambda: xk.verify_batch(pubs, msgs, sigs, fe_backend=fe_backend),
+        reps=3,
+    )
+    emit(f"xla_e2e_{N_CPU}", e2e_ms)
+    return N_CPU, e2e_ms, "xla"
+
+
+def _write_round(round_dir, parsed, rc):
+    os.makedirs(round_dir, exist_ok=True)
+    nums = [
+        int(m.group(1))
+        for p in glob.glob(os.path.join(round_dir, "BENCH_r*.json"))
+        if (m := re.search(r"BENCH_r(\d+)\.json$", os.path.basename(p)))
+    ]
+    n = max(nums, default=0) + 1
+    path = os.path.join(round_dir, f"BENCH_r{n:02d}.json")
+    with open(path, "w") as f:
+        json.dump(
+            {
+                "n": n,
+                "cmd": " ".join(sys.argv),
+                "rc": rc,
+                "tail": "",
+                "parsed": parsed,
+            },
+            f,
+            indent=1,
+        )
+        f.write("\n")
+    print(f"# bench round -> {path}", file=sys.stderr)
+
+
+def main(argv=None):
+    from scripts._bench_metrics import pop_metrics_out, write_snapshot
+
+    metrics_out = pop_metrics_out(argv)
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--fe-backend", default="vpu",
+                   choices=("vpu", "mxu", "mxu16"),
+                   help="limb-multiplier backend ([verify] fe_backend)")
+    p.add_argument("--round-dir", default="",
+                   help="append a BENCH_rNN.json round under DIR "
+                        "(for scripts/bench_check.py --dir DIR)")
+    args = p.parse_args(argv)
+    be = args.fe_backend
+    suffix = "" if be == "vpu" else f"_{be}"
+
+    def emit(metric, ms):
+        name = metric + suffix
+        _emitted[name] = round(ms, 3)
+        print(json.dumps({"metric": name, "value": round(ms, 3),
+                          "unit": "ms", "fe_backend": be}), flush=True)
+
+    if tpu_alive():
+        n, e2e_ms, kind = _profile_pallas(emit, be)
+    else:
+        print("# TPU tunnel is down — XLA fallback on the local backend",
+              file=sys.stderr)
+        pin_cpu_platform()
+        n, e2e_ms, kind = _profile_xla_fallback(emit, be)
+
+    sigs_per_s = round(n / (e2e_ms / 1e3), 1)
+    _emitted["ed25519_sigs_per_s" + suffix] = sigs_per_s
+    # headline line: carries the metric under its own key too so the
+    # driver's parsed-dict (last JSON line) gates by name in bench_check
+    print(json.dumps({
+        "metric": "ed25519_sigs_per_s" + suffix,
+        "value": sigs_per_s,
+        "unit": "sigs/s",
+        "fe_backend": be,
+        "backend": kind,
+        "ed25519_sigs_per_s" + suffix: sigs_per_s,
+    }), flush=True)
+
+    try:
+        from tendermint_tpu.libs.metrics import get_verify_metrics
+
+        get_verify_metrics().record_dispatch(
+            kind, "ed25519", n, e2e_ms / 1e3, fe_backend=be
+        )
+    except Exception:
+        pass
+    if metrics_out and os.path.dirname(metrics_out):
+        os.makedirs(os.path.dirname(metrics_out), exist_ok=True)
+    write_snapshot(metrics_out)
+    if args.round_dir:
+        _write_round(args.round_dir, dict(_emitted), 0)
     return 0
 
 

@@ -216,6 +216,13 @@ class VerifyConfig:
     audit_seed: int = 0
     # retries after a failed device dispatch before host fallback
     retries: int = 1
+    # limb-multiplier backend for the device verify kernels:
+    # "vpu" (elementwise schoolbook), "mxu" (int8-plane matmuls on the
+    # matrix unit), or "mxu16" (radix-2^16 repack, Pallas path only —
+    # degrades to "mxu" on the XLA kernels).  All are bit-exact; the
+    # audit/breaker machinery cross-checks them like any device backend.
+    # TM_FE_BACKEND env overrides.
+    fe_backend: str = "vpu"
 
 
 @dataclass

@@ -34,16 +34,46 @@ from tendermint_tpu.libs.metrics import get_verify_metrics
 
 
 def _record_dispatch(backend: str, algo: str, n: int, t0: float, ok,
-                     first: bool = False) -> None:
-    """One VerifyMetrics record per batch dispatch (size, latency, rejects).
-    Telemetry must never take down the verify path."""
+                     first: bool = False, fe_backend: str = "") -> None:
+    """One VerifyMetrics record per batch dispatch (size, latency, rejects,
+    and which limb-multiplier backend served the window).  Telemetry must
+    never take down the verify path."""
     try:
         get_verify_metrics().record_dispatch(
             backend, algo, n, time.perf_counter() - t0,
             rejects=n - int(np.count_nonzero(ok)), first=first,
+            fe_backend=fe_backend,
         )
     except Exception:
         pass
+
+
+# limb-multiplier backends for the device kernels (ops/fe_common.FE_BACKENDS;
+# duplicated here so pure-host users never import jax through this module)
+_FE_BACKENDS = ("vpu", "mxu", "mxu16")
+_default_fe_backend: Optional[str] = None
+
+
+def set_default_fe_backend(value: Optional[str]) -> None:
+    """Install the process-wide [verify] fe_backend choice (node composition
+    root).  TM_FE_BACKEND still overrides per-process."""
+    global _default_fe_backend
+    _default_fe_backend = value or None
+
+
+def _resolve_fe_backend(explicit: Optional[str]) -> str:
+    import os
+
+    v = explicit or os.environ.get("TM_FE_BACKEND", "") or \
+        _default_fe_backend or "vpu"
+    v = v.strip().lower()
+    if v in ("", "auto"):
+        return "vpu"
+    if v not in _FE_BACKENDS:
+        raise ValueError(
+            f"fe_backend must be one of {_FE_BACKENDS}, got {v!r}"
+        )
+    return v
 
 
 class SigItem(NamedTuple):
@@ -124,11 +154,18 @@ class TPUBatchVerifier:
     backend: "pallas" (fused kernel, needs a real TPU), "xla" (portable,
     mesh-shardable), or None = pick pallas when a TPU is reachable and no
     mesh was requested.
+
+    fe_backend: limb multiplier for the device kernels ("vpu" | "mxu" |
+    "mxu16"; ops/fe_common).  None = TM_FE_BACKEND env, then the [verify]
+    fe_backend config (set_default_fe_backend), then "vpu".  All backends
+    are bit-exact — the PR 9 audit/breaker guard treats them identically.
     """
 
     name = "tpu"
 
-    def __init__(self, mesh=None, backend: Optional[str] = None):
+    def __init__(self, mesh=None, backend: Optional[str] = None,
+                 fe_backend: Optional[str] = None):
+        self.fe_backend = _resolve_fe_backend(fe_backend)
         self._mesh = mesh
         self._tpu = None
         if backend is None:
@@ -185,14 +222,19 @@ class TPUBatchVerifier:
                 import jax
 
                 dev = None if jax.default_backend() == "tpu" else self._tpu
-                ok = self._kernel.verify_batch(pubs_a, msgs, sigs_a, device=dev)
+                ok = self._kernel.verify_batch(
+                    pubs_a, msgs, sigs_a, device=dev,
+                    fe_backend=self.fe_backend,
+                )
             else:
                 ok = self._kernel.verify_batch(
-                    pubs_a, msgs, sigs_a, mesh=self._mesh
+                    pubs_a, msgs, sigs_a, mesh=self._mesh,
+                    fe_backend=self.fe_backend,
                 )
         ok = np.asarray(ok, dtype=bool)
         self._warm.add("ed25519")
-        _record_dispatch(self.backend, "ed25519", len(pubs), t0, ok, first=first)
+        _record_dispatch(self.backend, "ed25519", len(pubs), t0, ok,
+                         first=first, fe_backend=self.fe_backend)
         return ok
 
     def verify_secp256k1(self, items: Sequence[SigItem]) -> np.ndarray:
@@ -216,15 +258,17 @@ class TPUBatchVerifier:
                 from tendermint_tpu.ops import secp256k1_pallas as _skp
 
                 dev = None if jax.default_backend() == "tpu" else self._tpu
-                ok = _skp.verify_batch(pubs, digs, sigs, device=dev)
+                ok = _skp.verify_batch(pubs, digs, sigs, device=dev,
+                                       fe_backend=self.fe_backend)
             else:
                 from tendermint_tpu.ops import secp256k1_verify as _sk
 
-                ok = _sk.verify_batch(pubs, digs, sigs, mesh=self._mesh)
+                ok = _sk.verify_batch(pubs, digs, sigs, mesh=self._mesh,
+                                      fe_backend=self.fe_backend)
         ok = np.asarray(ok, dtype=bool)
         self._warm.add("secp256k1")
         _record_dispatch(self.backend, "secp256k1", len(items), t0, ok,
-                         first=first)
+                         first=first, fe_backend=self.fe_backend)
         return ok
 
 

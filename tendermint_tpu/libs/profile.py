@@ -79,10 +79,14 @@ class Profiler:
         run_seconds: float = 0.0,
         compiled: bool = False,
         bytes_to_device: int = 0,
+        fe_backend: str = "",
     ) -> None:
         win = getattr(_tls, "window", None)
         entry = {
             "kind": kind,
+            # limb-multiplier backend that served this dispatch
+            # (ops/fe_common: vpu | mxu | mxu16; "" = host / not applicable)
+            "fe_backend": str(fe_backend),
             "height_base": win[0] if win else None,
             "heights": heights or (win[1] if win else 0),
             "bucket": list(bucket),
@@ -166,6 +170,7 @@ class Profiler:
                     "heights": e["heights"],
                     "dispatches": 0,
                     "kinds": [],
+                    "fe_backends": [],
                     "buckets": [],
                     "lanes_present": 0,
                     "lanes_dispatched": 0,
@@ -180,6 +185,9 @@ class Profiler:
             row["dispatches"] += 1
             if e["kind"] not in row["kinds"]:
                 row["kinds"].append(e["kind"])
+            fb = e.get("fe_backend", "")
+            if fb and fb not in row["fe_backends"]:
+                row["fe_backends"].append(fb)
             if e["bucket"] and e["bucket"] not in row["buckets"]:
                 row["buckets"].append(e["bucket"])
             row["lanes_present"] += e["lanes_present"]

@@ -167,6 +167,12 @@ class Node(BaseService):
 
         configure_device_guard(config.verify)
 
+        # [verify] fe_backend: which limb multiplier serves device verify
+        # windows (vpu schoolbook vs MXU int8-plane matmuls; ops/fe_common)
+        from tendermint_tpu.crypto.batch import set_default_fe_backend
+
+        set_default_fe_backend(getattr(config.verify, "fe_backend", None))
+
         if self.metrics is not None:
             # slow-subscriber drop accounting (libs/pubsub.py)
             m = self.metrics

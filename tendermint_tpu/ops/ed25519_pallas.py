@@ -15,16 +15,13 @@ than the XLA kernel — see bench.py for the driver-captured number).
 Algorithm (per 128-lane block, batch on lanes, limbs on sublanes):
 
   * Field arithmetic over GF(2^255-19), 20 radix-2^13 uint32 limbs in a
-    (20, B) layout. Bounds are a closed set under the op mix (proof below):
-    every "carried" field element has limbs <= M = 13000, so 20-term
-    schoolbook columns are <= 20 * M^2 = 3.38e9 < 2^32 and a single carry
-    round on the 40-limb product + fold-by-608 (2^260 = 608 mod p) + two
-    carry rounds on 20 limbs restore limbs <= M:
-      - fe_mul: col <= 3.38e9; round1 limb <= 8191+413k; fold <= 2.56e8;
-        roundA limb <= 19.1M; roundB limb0 <= 8191+2330+2432 = 12953 <= M.
-      - fe_add: 2M = 26000 -> 1 round -> limb0 <= 8191+3+1824 = 10018 <= M.
-      - fe_sub (a + K - b, K = 4p-ish with limbs >= 30336 > M): <= 45764
-        -> 1 round -> limb0 <= 8191+5+3040 = 11236 <= M.
+    (20, B) layout — shared with the secp256k1 kernel via ops/fe_common.py,
+    which also provides the MXU limb multiplier (int8-plane fe_mul behind
+    the [verify] fe_backend knob; the VPU schoolbook remains the default).
+    Overflow bounds are no longer hand-stated here: fe_common's bound_*
+    propagators recompute the closed set mechanically for every backend,
+    and tests/test_fe_common.py asserts closure (carried limbs <= 13000)
+    and that no intermediate reaches 2^32.
   * Double-scalar mult R' = [s]B + [h](-A) via 4-bit windowed Straus:
     64 MSB-first windows sharing 252 doublings; per window one mixed add
     from a constant niels table [0..15]B (affine, identity at digit 0) and
@@ -60,13 +57,14 @@ from jax.experimental.pallas import tpu as pltpu
 
 from tendermint_tpu.crypto import ed25519 as _ed
 from tendermint_tpu.ops import ed25519_verify as _xla
+from tendermint_tpu.ops import fe_common as _fc
 
 P = _ed.P
 L_ORDER = _ed.L
 NLIMB = 20
 BITS = 13
 MASK = (1 << BITS) - 1
-FOLD = 19 << 5  # 2^260 = 608 (mod p)
+FOLD = _fc.ED_FOLD  # 2^260 = 608 (mod p)
 LANES = 128  # batch lanes per pallas grid block
 NWIN = 64  # 4-bit windows covering s, h < 2^256
 
@@ -75,78 +73,18 @@ _D2_LIMBS = _xla._D2_LIMBS
 
 int_to_limbs = _xla.int_to_limbs
 
+# Field ops live in ops/fe_common.py now (one copy serves both curves and
+# all fe backends); these module-level names keep the original surface.
+_FE = {b: _fc.make_fe("ed25519", b) for b in _fc.FE_BACKENDS}
+_FE_VPU = _FE["vpu"]
 
-def _shift_rows_down(x, k=1):
-    """Rows move +k (top k rows become 0) — carry propagation shift."""
-    return jnp.pad(x[:-k, :], ((k, 0), (0, 0)))
-
-
-def _wrap_row0(c_top, nrows):
-    """Carry out of the top limb re-enters at limb 0 times 608."""
-    return jnp.pad(c_top * FOLD, ((0, nrows - 1), (0, 0)))
-
-
-def fe_carry1(x):
-    """One parallel carry round with wraparound (20 rows)."""
-    c = x >> BITS
-    return (x & MASK) + _shift_rows_down(c) + _wrap_row0(c[NLIMB - 1 :, :], NLIMB)
-
-
-def fe_add(a, b):
-    return fe_carry1(a + b)
-
-
-def fe_sub(a, b, ksub):
-    """ksub: (20, 1) multiple-of-p constant keeping the difference positive
-    (passed as a kernel input — Pallas kernels cannot capture array consts)."""
-    return fe_carry1(a + ksub - b)
-
-
-def fe_mul(a, b):
-    """(20, B) x (20, B) -> (20, B), limbs <= M = 13000 (bounds in header)."""
-    terms = []
-    for i in range(NLIMB):
-        p = a[i : i + 1, :] * b
-        terms.append(jnp.pad(p, ((i, NLIMB - i), (0, 0))))
-    prod = sum(terms)  # (40, B)
-    c = prod >> BITS
-    prod = (prod & MASK) + _shift_rows_down(c)  # carry within 40 limbs
-    lo = prod[:NLIMB, :] + prod[NLIMB:, :] * FOLD
-    return fe_carry1(fe_carry1(lo))
-
-
-def fe_sq(a):
-    return fe_mul(a, a)
-
-
-def fe_inv(z):
-    """z^(p-2) via the standard curve25519 addition chain: 254 sq + 11 mul."""
-
-    def sqn(x, n):
-        return lax.fori_loop(0, n, lambda _, v: fe_sq(v), x)
-
-    z2 = fe_sq(z)
-    z8 = sqn(z2, 2)
-    z9 = fe_mul(z, z8)
-    z11 = fe_mul(z2, z9)
-    z22 = fe_sq(z11)
-    z_5_0 = fe_mul(z9, z22)
-    z_10_5 = sqn(z_5_0, 5)
-    z_10_0 = fe_mul(z_10_5, z_5_0)
-    z_20_10 = sqn(z_10_0, 10)
-    z_20_0 = fe_mul(z_20_10, z_10_0)
-    z_40_20 = sqn(z_20_0, 20)
-    z_40_0 = fe_mul(z_40_20, z_20_0)
-    z_50_10 = sqn(z_40_0, 10)
-    z_50_0 = fe_mul(z_50_10, z_10_0)
-    z_100_50 = sqn(z_50_0, 50)
-    z_100_0 = fe_mul(z_100_50, z_50_0)
-    z_200_100 = sqn(z_100_0, 100)
-    z_200_0 = fe_mul(z_200_100, z_100_0)
-    z_250_50 = sqn(z_200_0, 50)
-    z_250_0 = fe_mul(z_250_50, z_50_0)
-    z_255_5 = sqn(z_250_0, 5)
-    return fe_mul(z_255_5, z11)  # z^(2^255 - 21) = z^(p-2)
+_shift_rows_down = _fc.shift_rows_down
+fe_carry1 = _fc.ed_fe_carry1
+fe_add = _fc.ed_fe_add
+fe_sub = _fc.ed_fe_sub
+fe_mul = _fc.ed_fe_mul
+fe_sq = _fc.ed_fe_sq
+fe_inv = _fc.ed_fe_inv
 
 
 # ---------------------------------------------------------------------------
@@ -154,47 +92,47 @@ def fe_inv(z):
 # ---------------------------------------------------------------------------
 
 
-def pt_add(p, q, d2, ksub):
+def pt_add(p, q, d2, ksub, fe=_FE_VPU):
     X1, Y1, Z1, T1 = p
     X2, Y2, Z2, T2 = q
-    A = fe_mul(fe_sub(Y1, X1, ksub), fe_sub(Y2, X2, ksub))
-    B = fe_mul(fe_add(Y1, X1), fe_add(Y2, X2))
-    C = fe_mul(fe_mul(T1, d2), T2)
-    Dv = fe_mul(fe_add(Z1, Z1), Z2)
-    E = fe_sub(B, A, ksub)
-    F = fe_sub(Dv, C, ksub)
-    G = fe_add(Dv, C)
-    H = fe_add(B, A)
-    return fe_mul(E, F), fe_mul(G, H), fe_mul(F, G), fe_mul(E, H)
+    A = fe.mul(fe.sub(Y1, X1, ksub), fe.sub(Y2, X2, ksub))
+    B = fe.mul(fe.add(Y1, X1), fe.add(Y2, X2))
+    C = fe.mul(fe.mul(T1, d2), T2)
+    Dv = fe.mul(fe.add(Z1, Z1), Z2)
+    E = fe.sub(B, A, ksub)
+    F = fe.sub(Dv, C, ksub)
+    G = fe.add(Dv, C)
+    H = fe.add(B, A)
+    return fe.mul(E, F), fe.mul(G, H), fe.mul(F, G), fe.mul(E, H)
 
 
-def pt_madd(p, ypx, ymx, t2d, ksub):
+def pt_madd(p, ypx, ymx, t2d, ksub, fe=_FE_VPU):
     """Mixed add with a precomputed niels point (y+x, y-x, 2dxy), Z=1.
     Digit 0 maps to (1, 1, 0) and yields p unchanged (scaled) — identity-safe."""
     X1, Y1, Z1, T1 = p
-    A = fe_mul(fe_sub(Y1, X1, ksub), ymx)
-    B = fe_mul(fe_add(Y1, X1), ypx)
-    C = fe_mul(T1, t2d)
-    Dv = fe_add(Z1, Z1)
-    E = fe_sub(B, A, ksub)
-    F = fe_sub(Dv, C, ksub)
-    G = fe_add(Dv, C)
-    H = fe_add(B, A)
-    return fe_mul(E, F), fe_mul(G, H), fe_mul(F, G), fe_mul(E, H)
+    A = fe.mul(fe.sub(Y1, X1, ksub), ymx)
+    B = fe.mul(fe.add(Y1, X1), ypx)
+    C = fe.mul(T1, t2d)
+    Dv = fe.add(Z1, Z1)
+    E = fe.sub(B, A, ksub)
+    F = fe.sub(Dv, C, ksub)
+    G = fe.add(Dv, C)
+    H = fe.add(B, A)
+    return fe.mul(E, F), fe.mul(G, H), fe.mul(F, G), fe.mul(E, H)
 
 
-def pt_double(p, ksub):
+def pt_double(p, ksub, fe=_FE_VPU):
     X1, Y1, Z1, _ = p
-    A = fe_sq(X1)
-    B = fe_sq(Y1)
-    ZZ = fe_sq(Z1)
-    C = fe_add(ZZ, ZZ)
-    H = fe_add(A, B)
-    xy = fe_add(X1, Y1)
-    E = fe_sub(H, fe_sq(xy), ksub)
-    G = fe_sub(A, B, ksub)
-    F = fe_add(C, G)
-    return fe_mul(E, F), fe_mul(G, H), fe_mul(F, G), fe_mul(E, H)
+    A = fe.sq(X1)
+    B = fe.sq(Y1)
+    ZZ = fe.sq(Z1)
+    C = fe.add(ZZ, ZZ)
+    H = fe.add(A, B)
+    xy = fe.add(X1, Y1)
+    E = fe.sub(H, fe.sq(xy), ksub)
+    G = fe.sub(A, B, ksub)
+    F = fe.add(C, G)
+    return fe.mul(E, F), fe.mul(G, H), fe.mul(F, G), fe.mul(E, H)
 
 
 # ---------------------------------------------------------------------------
@@ -271,7 +209,7 @@ def _canonical_ref(v, s1, s2):
 
 
 def ladder_math(consts, negax, ay, digs_get, digh_get, nwin: int = NWIN,
-                loop=lax.fori_loop):
+                loop=lax.fori_loop, fe_backend: str = "vpu"):
     """The windowed-Straus double-scalar multiply [s]B + [h](-A) — pure jnp,
     shared by the pallas kernel (on ref values) and the CPU parity tests
     (tests/test_pallas_interpret.py).  digs_get/digh_get: t -> (1, B)
@@ -279,7 +217,9 @@ def ladder_math(consts, negax, ay, digs_get, digh_get, nwin: int = NWIN,
     nwin < NWIN drives the identical code with small scalars; tests also
     swap `loop` for a plain Python loop so the whole thing evaluates
     eagerly (XLA's CPU compile of these graphs runs minutes — its
-    simplifier thrashes on the carry patterns).  Returns (X, Y, Z, T)."""
+    simplifier thrashes on the carry patterns).  fe_backend picks the limb
+    multiplier (fe_common.FE_BACKENDS).  Returns (X, Y, Z, T)."""
+    fe = _FE[fe_backend]
     B = negax.shape[1]
     zero = jnp.zeros((NLIMB, B), jnp.uint32)
     one = jnp.pad(jnp.ones((1, B), jnp.uint32), ((0, NLIMB - 1), (0, 0)))
@@ -287,13 +227,13 @@ def ladder_math(consts, negax, ay, digs_get, digh_get, nwin: int = NWIN,
     ksub = consts[:, 49:50]
 
     ident = (zero, one, one, zero)
-    a1 = (negax, ay, one, fe_mul(negax, ay))
+    a1 = (negax, ay, one, fe.mul(negax, ay))
 
     # per-signature table [0..15](-A): evens by doubling, odds by +(-A)
     tbl = [ident, a1]
     for j in range(2, 16):
-        tbl.append(pt_double(tbl[j // 2], ksub) if j % 2 == 0
-                   else pt_add(tbl[j - 1], a1, d2, ksub))
+        tbl.append(pt_double(tbl[j // 2], ksub, fe) if j % 2 == 0
+                   else pt_add(tbl[j - 1], a1, d2, ksub, fe))
     tbl_x = jnp.stack([t[0] for t in tbl])  # (16, 20, B)
     tbl_y = jnp.stack([t[1] for t in tbl])
     tbl_z = jnp.stack([t[2] for t in tbl])
@@ -308,7 +248,7 @@ def ladder_math(consts, negax, ay, digs_get, digh_get, nwin: int = NWIN,
 
     def body(t, acc):
         for _ in range(4):
-            acc = pt_double(acc, ksub)
+            acc = pt_double(acc, ksub, fe)
         ds = digs_get(t)  # (1, B)
         dh = digh_get(t)
         mk_s = [(ds == j).astype(jnp.uint32) for j in range(16)]
@@ -317,17 +257,18 @@ def ladder_math(consts, negax, ay, digs_get, digh_get, nwin: int = NWIN,
         ypx = sum(consts[:, j : j + 1] * mk_s[j] for j in range(16))
         ymx = sum(consts[:, 16 + j : 17 + j] * mk_s[j] for j in range(16))
         t2d = sum(consts[:, 32 + j : 33 + j] * mk_s[j] for j in range(16))
-        acc = pt_madd(acc, ypx, ymx, t2d, ksub)
+        acc = pt_madd(acc, ypx, ymx, t2d, ksub, fe)
         q = (select16(tbl_x, mk_h), select16(tbl_y, mk_h),
              select16(tbl_z, mk_h), select16(tbl_t, mk_h))
-        acc = pt_add(acc, q, d2, ksub)
+        acc = pt_add(acc, q, d2, ksub, fe)
         return acc
 
     return loop(0, nwin, body, ident)
 
 
 def _ladder_kernel(consts_ref, negax_ref, ay_ref, digs_ref, digh_ref,
-                   rlimb_ref, rsign_ref, out_ref, s1, s2):
+                   rlimb_ref, rsign_ref, out_ref, s1, s2,
+                   fe_backend: str = "vpu"):
     # window count comes from the digit rows: production always passes
     # (NWIN, B), while reduced parity tests drive the identical math with
     # fewer windows (small scalars)
@@ -336,18 +277,20 @@ def _ladder_kernel(consts_ref, negax_ref, ay_ref, digs_ref, digh_ref,
         lambda t: digs_ref[pl.ds(t, 1), :],
         lambda t: digh_ref[pl.ds(t, 1), :],
         nwin=digs_ref.shape[0],
+        fe_backend=fe_backend,
     )
 
-    zinv = fe_inv(Z)
-    x = _canonical_ref(fe_mul(X, zinv), s1, s2)
-    y = _canonical_ref(fe_mul(Y, zinv), s1, s2)
+    fe = _FE[fe_backend]
+    zinv = fe.inv(Z)
+    x = _canonical_ref(fe.mul(X, zinv), s1, s2)
+    y = _canonical_ref(fe.mul(Y, zinv), s1, s2)
     ok = jnp.all(y == rlimb_ref[:], axis=0, keepdims=True)
     ok = ok & ((x[0:1, :] & 1) == rsign_ref[:])
     out_ref[:] = ok.astype(jnp.uint32)
 
 
 def _ladder_call(negax, ay, digs, digh, rlimb, rsign, *, interpret=False,
-                 lanes=LANES):
+                 lanes=LANES, fe_backend="vpu"):
     """negax/ay/rlimb (20, N), digs/digh (nwin, N) — NWIN=64 in production,
     fewer in the reduced interpret tests — rsign (1, N); N % lanes == 0."""
     n = negax.shape[1]
@@ -357,7 +300,7 @@ def _ladder_call(negax, ay, digs, digh, rlimb, rsign, *, interpret=False,
     spec64 = pl.BlockSpec((nwin, lanes), lambda i: (0, i), memory_space=pltpu.VMEM)
     spec1 = pl.BlockSpec((1, lanes), lambda i: (0, i), memory_space=pltpu.VMEM)
     return pl.pallas_call(
-        _ladder_kernel,
+        partial(_ladder_kernel, fe_backend=fe_backend),
         out_shape=jax.ShapeDtypeStruct((1, n), jnp.uint32),
         grid=(n // lanes,),
         in_specs=[cspec, spec20, spec20, spec64, spec64, spec20, spec1],
@@ -643,14 +586,15 @@ def _prologue_call(msg_words, sig_words, *, interpret=False, lanes=LANES):
 
 
 def _device_verify(negax, ay, sig_words, msg_words, interpret=False,
-                   lanes=LANES):
+                   lanes=LANES, fe_backend="vpu"):
     """negax/ay (N, 20) uint32; sig_words (N, 16) uint32 LE; msg_words
     (N, nblocks*32) uint32 BE padded SHA-512 input. Returns (N,) bool."""
     digs, digh, rlimb, rsign = _prologue_call(
         msg_words.T, sig_words.T, interpret=interpret, lanes=lanes
     )
     ok = _ladder_call(
-        negax.T, ay.T, digs, digh, rlimb, rsign, interpret=interpret, lanes=lanes
+        negax.T, ay.T, digs, digh, rlimb, rsign, interpret=interpret,
+        lanes=lanes, fe_backend=fe_backend,
     )
     return ok[0].astype(bool)
 
@@ -658,14 +602,14 @@ def _device_verify(negax, ay, sig_words, msg_words, interpret=False,
 # Compiled entry for the real-device path. In interpret mode the plain
 # function is called eagerly instead: tracing the interpreted kernels into one
 # jit graph explodes into thousands of scalar XLA ops (a 6-minute CPU compile).
-_device_verify_jit = partial(jax.jit, static_argnames=("interpret", "lanes"))(
-    _device_verify
-)
+_device_verify_jit = partial(
+    jax.jit, static_argnames=("interpret", "lanes", "fe_backend")
+)(_device_verify)
 
 
-@partial(jax.jit, static_argnames=("lanes",))
+@partial(jax.jit, static_argnames=("lanes", "fe_backend"))
 def _device_verify_packed(negax, ay, pub_words, sig_words, tmpl, vidx, vwords,
-                          lanes=LANES):
+                          lanes=LANES, fe_backend="vpu"):
     """Transfer-minimizing verify: the padded SHA-512 input is ASSEMBLED ON
     DEVICE instead of shipped over the wire.
 
@@ -694,7 +638,8 @@ def _device_verify_packed(negax, ay, pub_words, sig_words, tmpl, vidx, vwords,
     mw = mw.at[8:16, :].set(bswap(pub_words.T))
     mw = mw.at[vidx, :].set(vwords.T)
     digs, digh, rlimb, rsign = _prologue_call(mw, sig_words.T, lanes=lanes)
-    ok = _ladder_call(negax.T, ay.T, digs, digh, rlimb, rsign, lanes=lanes)
+    ok = _ladder_call(negax.T, ay.T, digs, digh, rlimb, rsign, lanes=lanes,
+                      fe_backend=fe_backend)
     return ok[0].astype(bool)
 
 
@@ -778,11 +723,14 @@ def _bucket(n: int, lanes: int = LANES) -> int:
 
 
 def verify_batch(pubs: np.ndarray, msgs: Sequence[bytes], sigs: np.ndarray,
-                 interpret: bool = False, device=None) -> np.ndarray:
+                 interpret: bool = False, device=None,
+                 fe_backend: str = "vpu") -> np.ndarray:
     """Go-exact batched verify on the Pallas path. Same contract as
     ops.ed25519_verify.verify_batch. `device` pins the dispatch to a specific
     jax device (used by tests that run on the real chip while the default
-    backend is the virtual CPU mesh)."""
+    backend is the virtual CPU mesh). `fe_backend` selects the limb
+    multiplier (fe_common.FE_BACKENDS); every backend is bit-exact."""
+    fe_backend = _fc.normalize_backend(fe_backend)
     pubs = np.ascontiguousarray(pubs, dtype=np.uint8)
     sigs = np.ascontiguousarray(sigs, dtype=np.uint8)
     n = pubs.shape[0]
@@ -799,6 +747,7 @@ def verify_batch(pubs: np.ndarray, msgs: Sequence[bytes], sigs: np.ndarray,
         out[idx] = _verify_uniform(
             pubs[idx], [msgs[i] for i in idx], sigs[idx],
             neg_ax[idx], ay[idx], valid[idx], int(ln), interpret, device,
+            fe_backend,
         )
     return out
 
@@ -854,7 +803,7 @@ def pack_variable_words(pubs, msgs, sigs, ln: int, b: int):
 
 
 def _verify_uniform(pubs, msgs, sigs, neg_ax, ay, valid, ln, interpret,
-                    device=None):
+                    device=None, fe_backend="vpu"):
     n = pubs.shape[0]
     # interpret mode (CPU tests) has no tile-alignment constraint: shrink the
     # lane count so the eager interpreter does 16x less padded work.
@@ -881,7 +830,7 @@ def _verify_uniform(pubs, msgs, sigs, neg_ax, ay, valid, ln, interpret,
                 negax_d, ay_d, pubw_d,
                 put(_pad_rows(sig_words, b)),
                 put(tmpl), put(vrows), put(vwords),
-                lanes=lanes,
+                lanes=lanes, fe_backend=fe_backend,
             )
         )[:n]
         return ok & valid
@@ -907,6 +856,7 @@ def _verify_uniform(pubs, msgs, sigs, neg_ax, ay, valid, ln, interpret,
             put(msg_words),
             interpret=interpret,
             lanes=lanes,
+            fe_backend=fe_backend,
         )
     )[:n]
     return ok & valid

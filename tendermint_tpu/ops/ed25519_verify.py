@@ -37,6 +37,7 @@ collectives only appear in the commit-tally layer above
 from __future__ import annotations
 
 import hashlib
+import sys
 from functools import partial
 from typing import List, Optional, Sequence, Tuple
 
@@ -47,6 +48,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from tendermint_tpu.crypto import ed25519 as _ed
+from tendermint_tpu.ops import fe_common as _fc
 
 P = _ed.P
 L = _ed.L
@@ -110,10 +112,19 @@ def fe_sub(a, b):
     return fe_carry(a + _K_SUB - b, rounds=2)
 
 
+# Limb-multiplier backend for this module's kernels: "vpu" is the shifted
+# multiply-accumulate schoolbook below; "mxu" computes the same columns as 4
+# int8 matmuls (fe_common.mul_columns_batch) so the 400 row-products land on
+# the matrix unit. Set only via _compiled_kernel's trace-time wrapper — the
+# jit cache is keyed on it, so each backend traces its own kernel.
+_FE_BACKEND = "vpu"
+
+
 def fe_mul(a, b):
     """Schoolbook product via 20 shifted multiply-accumulates, then reduce.
 
-    Bounds (audited; regression-pinned in tests/test_ops_ed25519.py):
+    Bounds (audited; regression-pinned in tests/test_ops_ed25519.py and
+    recomputed mechanically by fe_common.bound_* in tests/test_fe_common.py):
     carried inputs have limbs ≤ ~8800 (fe_sub's limb-0 wraparound term is
     the max — see fe_carry), and fe_mul is proven well past that (stressed
     to 13000). The 41st product row is REQUIRED: carries ripple one row
@@ -121,10 +132,15 @@ def fe_mul(a, b):
     at the margin, e.g. top limbs 8192·8192 = 2^26 — would be silently
     dropped (the same mechanism as the secp bug fixed in
     secp256k1_verify.fe_mul). Row 40 folds as 2^520 ≡ 608² (mod p)."""
-    shape = jnp.broadcast_shapes(a.shape[:-1], b.shape[:-1])
-    prod = jnp.zeros(shape + (2 * NLIMB + 1,), dtype=jnp.uint32)
-    for i in range(NLIMB):
-        prod = prod.at[..., i : i + NLIMB].add(a[..., i : i + 1] * b)
+    if _FE_BACKEND != "vpu":
+        # identical integers per column (exact int32 recombination), so the
+        # carry/fold tail below is untouched — bit-exact with the VPU path
+        prod = _fc.mul_columns_batch(a, b, 2 * NLIMB + 1, split=7)
+    else:
+        shape = jnp.broadcast_shapes(a.shape[:-1], b.shape[:-1])
+        prod = jnp.zeros(shape + (2 * NLIMB + 1,), dtype=jnp.uint32)
+        for i in range(NLIMB):
+            prod = prod.at[..., i : i + NLIMB].add(a[..., i : i + 1] * b)
     # local carries inside the 41-limb product (no wrap needed: value < 2^520)
     for _ in range(3):
         c = prod >> BITS
@@ -276,19 +292,24 @@ def _verify_kernel(neg_ax, ay, s_words, h_words, r_limbs, r_sign):
 _kernel_cache = {}
 
 
-def _compiled_kernel(batch: int, mesh=None):
+def _compiled_kernel(batch: int, mesh=None, fe_backend: str = "vpu"):
     # Mesh hashes by devices+axis_names — safe cache key (id() could be reused
     # by a new Mesh after gc and serve a stale sharding)
-    key = (batch, mesh)
+    if fe_backend not in ("vpu", "mxu"):
+        fe_backend = "mxu" if fe_backend == "mxu16" else "vpu"
+    key = (batch, mesh, fe_backend)
     fn = _kernel_cache.get(key)
     if fn is None:
+        kernel = _fc.trace_with_backend(
+            sys.modules[__name__], _verify_kernel, fe_backend
+        )
         if mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as PS
 
             data = NamedSharding(mesh, PS(mesh.axis_names[0]))
-            fn = jax.jit(_verify_kernel, in_shardings=(data,) * 6, out_shardings=data)
+            fn = jax.jit(kernel, in_shardings=(data,) * 6, out_shardings=data)
         else:
-            fn = jax.jit(_verify_kernel)
+            fn = jax.jit(kernel)
         _kernel_cache[key] = fn
     return fn
 
@@ -397,13 +418,17 @@ def verify_batch(
     msgs: Sequence[bytes],
     sigs: np.ndarray,
     mesh=None,
+    fe_backend: str = "vpu",
 ) -> np.ndarray:
     """Batched Go-exact ed25519 verify.
 
     pubs (N, 32) uint8, msgs list of N byte strings, sigs (N, 64) uint8.
     Returns (N,) bool.  One device dispatch per call (padded to a size bucket
-    to bound recompiles).
+    to bound recompiles).  fe_backend picks the limb multiplier ("vpu" |
+    "mxu"; "mxu16" degrades to "mxu" here — the 16-limb repack is row-layout
+    only); every backend is bit-exact.
     """
+    fe_backend = _fc.normalize_backend(fe_backend)
     n = len(pubs)
     if n == 0:
         return np.zeros((0,), dtype=bool)
@@ -430,5 +455,5 @@ def verify_batch(
 
         data = NamedSharding(mesh, PS(mesh.axis_names[0]))
         args = [jax.device_put(a, data) for a in args]
-    ok = np.asarray(_compiled_kernel(b, mesh)(*args))[:n]
+    ok = np.asarray(_compiled_kernel(b, mesh, fe_backend)(*args))[:n]
     return ok & valid

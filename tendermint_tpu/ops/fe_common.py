@@ -1,0 +1,751 @@
+"""Shared radix-2^13 field arithmetic for the ed25519/secp256k1 kernels.
+
+Both Pallas ladders (ops/ed25519_pallas.py, ops/secp256k1_pallas.py) used to
+carry their own copy of the row-layout field ops; this module owns them now,
+plus the MXU limb multiplier that serves both curves:
+
+  backend "vpu"    broadcast schoolbook row-products — 400 uint32 multiplies
+                   per fe_mul, all on the vector unit (the original path).
+  backend "mxu"    each 13-bit limb splits into two int8 planes
+                   (lo = a & 0x7F, hi = a >> 7; hi <= 101 for carried limbs)
+                   and the 400 row-products become 4 int8 batched outer
+                   products via lax.dot_general with int32 accumulation.
+                   The recombined columns are *identical integers* to the
+                   VPU columns, so the existing carry/fold tails produce
+                   bit-identical limbs.
+  backend "mxu16"  radix-2^16 repack: operands fold below 2^256, repack to
+                   16 rows of 16 bits, multiply as 4 uint8-plane outer
+                   products (256 row-products per plane pair, -36% vs the
+                   20-limb mapping), fold/carry in radix-16, convert back.
+                   Congruent mod p (same residue, possibly a different
+                   in-range representative) — the property suite checks it
+                   against the bignum oracle, and canonical encoding is
+                   unchanged.
+
+Layouts: row (NLIMB, B) — limbs on sublanes, batch on lanes (Pallas);
+batch-leading (..., NLIMB) for the XLA kernels (mul_columns_batch).
+
+Every bound claimed here is recomputed mechanically by the pure-Python
+propagators at the bottom (bound_*), which mirror the jnp code step by step
+on per-row maxima; tests/test_fe_common.py asserts closure of the carried
+set and that no intermediate reaches 2^32.  Carried-limb closed-set bounds:
+ed25519 limbs <= M_ED = 13000; secp256k1 is non-uniform (the two-term fold
+2^260 = 2^36 + 15632 re-enters at rows 0 and 2) — see bound_closed_set().
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from types import SimpleNamespace
+from typing import List, Sequence, Tuple
+
+import jax.numpy as jnp
+from jax import lax
+
+NLIMB = 20
+BITS = 13
+MASK = (1 << BITS) - 1
+
+ED_P = (1 << 255) - 19
+SECP_P = (1 << 256) - (1 << 32) - 977
+
+# 2^260 mod p, used by the radix-13 carry wraps
+ED_FOLD = 19 << 5  # 608
+SECP_FOLD_SMALL = 15632
+SECP_FOLD_SHIFT = 10  # ... + 2^36 = (c << 10) two rows up
+
+# 2^256 mod p, used by the mxu16 pre-fold and radix-16 wraps:
+# list of (row, multiplier, shift) placements in the target radix.
+ED_FOLD256_13 = ((0, 19 << 1, 0),)  # 2^256 = 38 (mod p), radix-13 row 0
+SECP_FOLD256_13 = ((0, 977, 0), (2, 1, 6))  # 2^32 = 2^(13*2) * 2^6
+ED_FOLD256_16 = ((0, 38, 0),)
+SECP_FOLD256_16 = ((0, 977, 0), (2, 1, 0))  # 2^32 = 2^(16*2)
+
+ED_M = 13000  # uniform carried-limb bound (closed set, asserted in tests)
+
+FE_BACKENDS = ("vpu", "mxu", "mxu16")
+
+_R16 = 16  # radix-2^16 rows covering a value < 2^256
+MASK16 = (1 << 16) - 1
+
+
+def shift_rows_down(x, k=1):
+    """Rows move +k (top k rows become 0) — carries to higher limbs."""
+    return jnp.pad(x[:-k, :], ((k, 0), (0, 0)))
+
+
+def _pad_row(x, row, nrows):
+    return jnp.pad(x, ((row, nrows - 1 - row), (0, 0)))
+
+
+# ---------------------------------------------------------------------------
+# Product columns — the only part of fe_mul that differs between backends.
+# cols[k] = sum_{i+j=k} a_i * b_j, exact in uint32 (callers guarantee the
+# column bound; see bound_mul_columns).
+# ---------------------------------------------------------------------------
+
+
+def _columns_vpu_rows(a, b, out_rows):
+    terms = []
+    for i in range(NLIMB):
+        p = a[i : i + 1, :] * b  # (NLIMB, B)
+        terms.append(jnp.pad(p, ((i, out_rows - NLIMB - i), (0, 0))))
+    return sum(terms)
+
+
+def _plane_outer(a_lo, a_hi, b_lo, b_hi, batch_axis):
+    """4 batched outer products on the plane pairs, int32 accumulation.
+    Returns (ll, lh, hl, hh), each (B, n, n) with batch dims leading."""
+    dn = (((), ()), ((batch_axis,), (batch_axis,)))
+    dot = partial(lax.dot_general, dimension_numbers=dn,
+                  preferred_element_type=jnp.int32)
+    return (dot(a_lo, b_lo), dot(a_lo, b_hi),
+            dot(a_hi, b_lo), dot(a_hi, b_hi))
+
+
+def _bcast_lanes(a, b):
+    """Broadcast a (rows, 1) constant operand against a (rows, B) one — the
+    VPU elementwise path broadcasts implicitly, but dot_general batch dims
+    must match exactly."""
+    if a.shape[-1] != b.shape[-1]:
+        B = max(a.shape[-1], b.shape[-1])
+        a = jnp.broadcast_to(a, a.shape[:-1] + (B,))
+        b = jnp.broadcast_to(b, b.shape[:-1] + (B,))
+    return a, b
+
+
+def _columns_mxu_rows(a, b, out_rows, split=7):
+    """Same columns as _columns_vpu_rows via the MXU mapping.  With split=7
+    the planes are int8 (lo = x & 0x7F, hi = x >> 7; hi <= 127 needs limbs
+    <= 16383 — the ed25519 carried set qualifies).  secp256k1's carried
+    limb 0 can reach ~24k (the 15632 fold re-entry), so it uses split=8
+    with uint8 planes (hi <= 93) — the MXU takes s8 and u8 operands alike.
+    Recombination is exact in int32 either way:
+    a_i*b_j = ll + ((lh + hl) << split) + (hh << 2*split) < 2^31."""
+    a, b = _bcast_lanes(a, b)
+    dt = jnp.int8 if split == 7 else jnp.uint8
+    m = (1 << split) - 1
+    a_lo = (a & m).astype(dt)
+    a_hi = (a >> split).astype(dt)
+    b_lo = (b & m).astype(dt)
+    b_hi = (b >> split).astype(dt)
+    ll, lh, hl, hh = _plane_outer(a_lo, a_hi, b_lo, b_hi, batch_axis=1)
+    op = (ll + ((lh + hl) << split) + (hh << (2 * split))).astype(jnp.uint32)
+    op = jnp.transpose(op, (1, 2, 0))  # (i, j, B): op[i] == a_i * b (rows j)
+    cols = jnp.zeros((out_rows, a.shape[1]), jnp.uint32)
+    for i in range(NLIMB):
+        cols = cols + jnp.pad(op[i], ((i, out_rows - NLIMB - i), (0, 0)))
+    return cols
+
+
+def mul_columns_rows(a, b, out_rows, backend="vpu", split=7):
+    """(NLIMB, B) x (NLIMB, B) -> (out_rows, B) schoolbook product columns."""
+    if backend == "vpu":
+        return _columns_vpu_rows(a, b, out_rows)
+    if backend == "mxu":
+        return _columns_mxu_rows(a, b, out_rows, split=split)
+    raise ValueError(f"unknown fe backend {backend!r}")
+
+
+def trace_with_backend(mod, kernel, fe_backend):
+    """Wrap `kernel` so its trace runs with mod._FE_BACKEND = fe_backend.
+
+    The XLA verify modules branch on a module global inside fe_mul while
+    BUILDING the graph (threading a parameter through every pt_* helper
+    would churn their whole call tree); callers key their jit cache on the
+    backend so each compiled artifact deterministically embeds one choice."""
+    if fe_backend == "vpu":
+        return kernel
+
+    def traced(*args):
+        prev = mod._FE_BACKEND
+        mod._FE_BACKEND = fe_backend
+        try:
+            return kernel(*args)
+        finally:
+            mod._FE_BACKEND = prev
+
+    return traced
+
+
+def mul_columns_batch(a, b, out_cols, backend="mxu", split=7):
+    """Batch-leading variant for the XLA kernels: (..., NLIMB) operands ->
+    (..., out_cols) columns.  Only the MXU mapping lives here — the XLA
+    kernels keep their own VPU-style column code.  split follows the same
+    per-curve rule as _columns_mxu_rows (7 -> int8 planes for ed25519,
+    8 -> uint8 planes for secp256k1's taller carried limbs)."""
+    if backend != "mxu":
+        raise ValueError(f"mul_columns_batch serves backend 'mxu', not {backend!r}")
+    if a.shape != b.shape:
+        shp = jnp.broadcast_shapes(a.shape, b.shape)
+        a = jnp.broadcast_to(a, shp)
+        b = jnp.broadcast_to(b, shp)
+    dt = jnp.int8 if split == 7 else jnp.uint8
+    m = (1 << split) - 1
+    a_lo = (a & m).astype(dt)
+    a_hi = (a >> split).astype(dt)
+    b_lo = (b & m).astype(dt)
+    b_hi = (b >> split).astype(dt)
+    nb = a.ndim - 1
+    dn = (((), ()), (tuple(range(nb)), tuple(range(nb))))
+    dot = partial(lax.dot_general, dimension_numbers=dn,
+                  preferred_element_type=jnp.int32)
+    ll = dot(a_lo, b_lo)
+    lh = dot(a_lo, b_hi)
+    hl = dot(a_hi, b_lo)
+    hh = dot(a_hi, b_hi)
+    op = (ll + ((lh + hl) << split)
+          + (hh << (2 * split))).astype(jnp.uint32)  # (..., i, j)
+    cols = jnp.zeros(a.shape[:-1] + (out_cols,), jnp.uint32)
+    for i in range(NLIMB):
+        cols = cols.at[..., i : i + NLIMB].add(op[..., i, :])
+    return cols
+
+
+# ---------------------------------------------------------------------------
+# ed25519 — GF(2^255 - 19), carry wrap 2^260 = 608 (mod p)
+# ---------------------------------------------------------------------------
+
+
+def ed_fe_carry1(x):
+    """One parallel carry round with wraparound (NLIMB rows)."""
+    c = x >> BITS
+    return (x & MASK) + shift_rows_down(c) + _pad_row(
+        c[NLIMB - 1 :, :] * ED_FOLD, 0, NLIMB
+    )
+
+
+def ed_fe_add(a, b):
+    return ed_fe_carry1(a + b)
+
+
+def ed_fe_sub(a, b, ksub):
+    """ksub: (NLIMB, 1) multiple-of-p constant keeping the difference
+    positive (a kernel input — Pallas kernels cannot capture array consts)."""
+    return ed_fe_carry1(a + ksub - b)
+
+
+def ed_fe_mul(a, b, backend="vpu"):
+    """(NLIMB, B) x (NLIMB, B) -> carried limbs (<= M_ED; bound_fe_mul
+    recomputes the chain mechanically)."""
+    if backend == "mxu16":
+        return _mul16_rows(a, b, ED_FOLD256_13, ED_FOLD256_16, ed_fe_carry1, 3)
+    prod = mul_columns_rows(a, b, 2 * NLIMB, backend, split=7)  # (40, B)
+    c = prod >> BITS
+    prod = (prod & MASK) + shift_rows_down(c)  # carry within 40 limbs
+    lo = prod[:NLIMB, :] + prod[NLIMB:, :] * ED_FOLD
+    return ed_fe_carry1(ed_fe_carry1(lo))
+
+
+def ed_fe_sq(a, backend="vpu"):
+    return ed_fe_mul(a, a, backend)
+
+
+def ed_fe_inv(z, backend="vpu"):
+    """z^(p-2) via the standard curve25519 addition chain: 254 sq + 11 mul."""
+    sq = partial(ed_fe_sq, backend=backend)
+    mul = partial(ed_fe_mul, backend=backend)
+
+    def sqn(x, n):
+        return lax.fori_loop(0, n, lambda _, v: sq(v), x)
+
+    z2 = sq(z)
+    z8 = sqn(z2, 2)
+    z9 = mul(z, z8)
+    z11 = mul(z2, z9)
+    z22 = sq(z11)
+    z_5_0 = mul(z9, z22)
+    z_10_0 = mul(sqn(z_5_0, 5), z_5_0)
+    z_20_0 = mul(sqn(z_10_0, 10), z_10_0)
+    z_40_0 = mul(sqn(z_20_0, 20), z_20_0)
+    z_50_0 = mul(sqn(z_40_0, 10), z_10_0)
+    z_100_0 = mul(sqn(z_50_0, 50), z_50_0)
+    z_200_0 = mul(sqn(z_100_0, 100), z_100_0)
+    z_250_0 = mul(sqn(z_200_0, 50), z_50_0)
+    return mul(sqn(z_250_0, 5), z11)  # z^(2^255 - 21) = z^(p-2)
+
+
+# ---------------------------------------------------------------------------
+# secp256k1 — GF(2^256 - 2^32 - 977), two-term wrap 2^260 = 2^36 + 15632
+# ---------------------------------------------------------------------------
+
+
+def _secp_wrap_top(c_top, nrows):
+    """Carry out of limb 19 (>= 2^260) re-enters as *15632 at row 0 and
+    << 10 at row 2 (pad placements, no scatter — Mosaic-friendly)."""
+    return _pad_row(c_top * SECP_FOLD_SMALL, 0, nrows) + _pad_row(
+        c_top << SECP_FOLD_SHIFT, 2, nrows
+    )
+
+
+def secp_fe_carry(x, rounds=3):
+    for _ in range(rounds):
+        c = x >> BITS
+        x = (x & MASK) + shift_rows_down(c) + _secp_wrap_top(
+            c[NLIMB - 1 :, :], NLIMB
+        )
+    return x
+
+
+def secp_fe_add(a, b):
+    # 3 rounds: the two-term fold can leave limbs ~3*MASK after two
+    return secp_fe_carry(a + b, rounds=3)
+
+
+def secp_fe_sub(a, b, ksub):
+    """ksub (NLIMB, 1): multiple-of-p constant with every limb >= 2*MASK."""
+    return secp_fe_carry(a + ksub - b, rounds=3)
+
+
+def secp_fe_mul(a, b, backend="vpu"):
+    """Row port of secp256k1_verify.fe_mul (41-row product, 24-row fold
+    temp — that docstring holds the ripple-carry proof; bound_fe_mul
+    recomputes it for every backend)."""
+    if backend == "mxu16":
+        return _mul16_rows(
+            a, b, SECP_FOLD256_13, SECP_FOLD256_16,
+            partial(secp_fe_carry, rounds=1), 5,
+        )
+    prod = mul_columns_rows(a, b, 2 * NLIMB + 1, backend, split=8)  # (41, B)
+    for _ in range(3):
+        c = prod >> BITS
+        prod = (prod & MASK) + shift_rows_down(c)
+    hi = prod[NLIMB:, :]  # (21, B)
+    # 24-row temp assembled from pads (no scatter):
+    #   rows 0..19 = lo, += hi*15632 at rows 0..20, += hi<<10 at rows 2..22
+    tmp = (
+        jnp.pad(prod[:NLIMB, :], ((0, 4), (0, 0)))
+        + jnp.pad(hi * SECP_FOLD_SMALL, ((0, 3), (0, 0)))
+        + jnp.pad(hi << SECP_FOLD_SHIFT, ((2, 1), (0, 0)))
+    )
+    for _ in range(2):
+        c = tmp >> BITS
+        tmp = (tmp & MASK) + shift_rows_down(c)
+    lo = tmp[:NLIMB, :]
+    for t_idx in range(4):
+        t = tmp[NLIMB + t_idx : NLIMB + t_idx + 1, :]
+        lo = lo + _pad_row(t * SECP_FOLD_SMALL, t_idx, NLIMB)
+        lo = lo + _pad_row(t << SECP_FOLD_SHIFT, t_idx + 2, NLIMB)
+    return secp_fe_carry(lo, rounds=5)
+
+
+def secp_fe_sq(a, backend="vpu"):
+    return secp_fe_mul(a, a, backend)
+
+
+def secp_fe_mul_small(a, k: int):
+    return secp_fe_carry(a * jnp.uint32(k), rounds=4)
+
+
+def secp_fe_inv(z, backend="vpu"):
+    """z^(p-2), plain MSB-first square-and-multiply (tests only — the secp
+    ladder kernel eliminated inversion; see secp256k1_pallas)."""
+    mul = partial(secp_fe_mul, backend=backend)
+    e = SECP_P - 2
+    acc = z
+    for bit in bin(e)[3:]:  # skip the leading 1
+        acc = mul(acc, acc)
+        if bit == "1":
+            acc = mul(acc, z)
+    return acc
+
+
+# ---------------------------------------------------------------------------
+# mxu16 — radix-2^16 repack shared by both curves
+# ---------------------------------------------------------------------------
+
+
+def _fold_bits256_13(a, terms):
+    """Fold bits >= 256 of a radix-13 element (limb 19 covers bits 247..):
+    value becomes < 2^256 with limbs <= ~max(in) + t*mult (exact)."""
+    t = a[NLIMB - 1 :, :] >> 9
+    out = a - _pad_row(t << 9, NLIMB - 1, NLIMB)
+    for row, mult, shift in terms:
+        out = out + _pad_row((t * mult) << shift, row, NLIMB)
+    return out
+
+
+def _seq_carry16(w):
+    """Exact sequential carry over the 16 rows, 15 steps on the VPU."""
+    for k in range(_R16 - 1):
+        c = w[k : k + 1, :] >> 16
+        w = w - _pad_row(c << 16, k, _R16) + _pad_row(c, k + 1, _R16)
+    return w
+
+
+def _repack_13to16(a, fold256_16):
+    """(NLIMB, B) radix-13 rows (value < 2^256 + eps after the prefold) ->
+    (16, B) radix-16 rows, each < 2^16.  The prefold clears bits >= 256 of
+    limb 19, but the lower limbs can still sum just past 2^256 (all-MASK
+    input is 2^260 - 1), so the carry out of row 15 — at most a couple of
+    units — wraps through the 2^256 fold terms and a second sequential
+    pass settles it; the value then provably fits 256 bits."""
+    w = jnp.zeros((_R16, a.shape[1]), jnp.uint32)
+    for i in range(NLIMB):
+        q, r = divmod(BITS * i, 16)
+        w = w + _pad_row(a[i : i + 1, :] << r, q, _R16)
+    w = _seq_carry16(w)
+    c = w[_R16 - 1 :, :] >> 16
+    w = w - _pad_row(c << 16, _R16 - 1, _R16)
+    for row, mult, shift in fold256_16:
+        w = w + _pad_row((c * mult) << shift, row, _R16)
+    return _seq_carry16(w)
+
+
+def _columns16_mxu(wa, wb):
+    """(16, B)^2 radix-16 rows -> (33, B) uint32 product columns.  uint8
+    planes lo = w & 0xFF, hi = w >> 8; the hh plane re-enters one row up
+    (hh << 16 is exactly one radix-16 limb) so no column crosses 2^32:
+    col <= 16 * (255^2 + 2*255^2*256) + 16*255^2 ~ 5.4e8."""
+    wa, wb = _bcast_lanes(wa, wb)
+    a_lo = (wa & 0xFF).astype(jnp.uint8)
+    a_hi = (wa >> 8).astype(jnp.uint8)
+    b_lo = (wb & 0xFF).astype(jnp.uint8)
+    b_hi = (wb >> 8).astype(jnp.uint8)
+    ll, lh, hl, hh = _plane_outer(a_lo, a_hi, b_lo, b_hi, batch_axis=1)
+    low = (ll + ((lh + hl) << 8)).astype(jnp.uint32)
+    hh = hh.astype(jnp.uint32)
+    low = jnp.transpose(low, (1, 2, 0))  # (i, j, B)
+    hh = jnp.transpose(hh, (1, 2, 0))
+    nrows = 2 * _R16 + 1  # 33: columns 0..30 plus the hh/carry spill row
+    cols = jnp.zeros((nrows, wa.shape[1]), jnp.uint32)
+    for i in range(_R16):
+        cols = cols + jnp.pad(low[i], ((i, nrows - _R16 - i), (0, 0)))
+        cols = cols + jnp.pad(hh[i], ((i + 1, nrows - _R16 - i - 1), (0, 0)))
+    return cols
+
+
+def _carry16(x, rounds, wrap_terms=()):
+    """Parallel radix-16 carry rounds.  With wrap_terms (16 rows = a value
+    mod 2^256) the carry out of row 15 re-enters as 2^256's placements;
+    without them the top row keeps its excess bits (nothing is dropped —
+    exactness over tidiness for the intermediate stacks)."""
+    nrows = x.shape[0]
+    for _ in range(rounds):
+        c = x >> 16
+        if wrap_terms:
+            x = (x & MASK16) + shift_rows_down(c)
+            for row, mult, shift in wrap_terms:
+                x = x + _pad_row((c[nrows - 1 :, :] * mult) << shift, row, nrows)
+        else:
+            keep = _pad_row(c[nrows - 1 :, :] << 16, nrows - 1, nrows)
+            x = (x & MASK16) + shift_rows_down(c) + keep
+    return x
+
+
+def _fold16(cols, terms):
+    """Fold rows >= 16 of the (33, B) column stack back under 2^256 using
+    2^256 = sum(mult << 16*row) placements; returns (16, B).  Two passes:
+    the first can land past row 15 again (secp's +2^32 term), so it carries
+    and folds once more — bounded because the second-pass rows are small."""
+    spill = max(row for row, _, _ in terms) + 1  # rows >= 16 after pass one
+    hi = cols[_R16:, :]  # (17, B): multiples of 2^256
+    lo = jnp.pad(cols[:_R16, :], ((0, spill), (0, 0)))  # (16+spill, B)
+    for row, mult, _ in terms:
+        lo = lo + jnp.pad(hi * mult, ((row, spill - row - 1), (0, 0)))
+    lo = _carry16(lo, rounds=2)  # keeps the second fold's products < 2^32
+    out = lo[:_R16, :]
+    for j in range(spill):
+        h = lo[_R16 + j : _R16 + j + 1, :]
+        for row, mult, _ in terms:
+            out = out + _pad_row(h * mult, row + j, _R16)
+    return out
+
+
+def _mul16_rows(a, b, fold256_13, fold256_16, carry13_1, tail_rounds):
+    """The radix-2^16 fe_mul: pre-fold below 2^256, repack, uint8-plane
+    multiply, radix-16 fold/carry, convert back to radix-13, final carry."""
+    wa = _repack_13to16(_fold_bits256_13(a, fold256_13), fold256_16)
+    wb = _repack_13to16(_fold_bits256_13(b, fold256_13), fold256_16)
+    cols = _carry16(_columns16_mxu(wa, wb), rounds=2)
+    w = _carry16(_fold16(cols, fold256_16), rounds=2, wrap_terms=fold256_16)
+    out = jnp.zeros((NLIMB, a.shape[1]), jnp.uint32)
+    for k in range(_R16):
+        q, r = divmod(16 * k, BITS)
+        out = out + _pad_row(w[k : k + 1, :] << r, q, NLIMB)
+    x = out
+    for _ in range(tail_rounds):
+        x = carry13_1(x)
+    return x
+
+
+# ---------------------------------------------------------------------------
+# Backend namespaces — what the Pallas kernels thread through their point ops
+# ---------------------------------------------------------------------------
+
+
+def make_fe(curve: str, backend: str = "vpu") -> SimpleNamespace:
+    """Uniform op namespace: mul/sq/add/sub/inv/carry (+ mul_small on secp).
+    add/sub/carry are backend-independent (pure VPU); mul/sq/inv honor the
+    backend."""
+    if backend not in FE_BACKENDS:
+        raise ValueError(f"fe backend must be one of {FE_BACKENDS}, got {backend!r}")
+    if curve == "ed25519":
+        return SimpleNamespace(
+            curve=curve, backend=backend,
+            mul=partial(ed_fe_mul, backend=backend),
+            sq=partial(ed_fe_sq, backend=backend),
+            inv=partial(ed_fe_inv, backend=backend),
+            add=ed_fe_add, sub=ed_fe_sub, carry=ed_fe_carry1,
+        )
+    if curve == "secp256k1":
+        return SimpleNamespace(
+            curve=curve, backend=backend,
+            mul=partial(secp_fe_mul, backend=backend),
+            sq=partial(secp_fe_sq, backend=backend),
+            inv=partial(secp_fe_inv, backend=backend),
+            add=secp_fe_add, sub=secp_fe_sub, carry=secp_fe_carry,
+            mul_small=secp_fe_mul_small,
+        )
+    raise ValueError(f"unknown curve {curve!r}")
+
+
+def normalize_backend(value) -> str:
+    """Config/env -> backend name ('' / None / 'auto' mean the VPU path)."""
+    v = (value or "vpu").strip().lower()
+    if v in ("", "auto"):
+        v = "vpu"
+    if v not in FE_BACKENDS:
+        raise ValueError(f"[verify] fe_backend must be one of {FE_BACKENDS}, got {value!r}")
+    return v
+
+
+# ---------------------------------------------------------------------------
+# Bound propagation — pure-Python mirrors of the pipelines above on per-row
+# maxima.  tests/test_fe_common.py drives these to re-prove, mechanically,
+# the overflow-freedom claims that used to live in the ed25519_pallas header
+# comment (ISSUE 10 satellite: assert the bounds instead of stating them).
+# Every helper returns (bounds, max_intermediate_seen).
+# ---------------------------------------------------------------------------
+
+U32 = 1 << 32
+
+
+def _b_shift_down(bounds: List[int], k=1) -> List[int]:
+    return [0] * k + bounds[:-k]
+
+
+def _b_carry_round(bounds, wrap_terms) -> Tuple[List[int], int]:
+    """Mirror of one (x & MASK) + shift(c) + wrap(c_top) round."""
+    c = [b >> BITS for b in bounds]
+    out = [min(b, MASK) for b in bounds]
+    out = [o + s for o, s in zip(out, _b_shift_down(c))]
+    for row, mult, shift in wrap_terms:
+        out[row] += (c[NLIMB - 1] * mult) << shift
+    return out, max(out)
+
+
+def bound_mul_columns(ba: Sequence[int], bb: Sequence[int], out_rows: int) -> List[int]:
+    """Column maxima — identical for vpu and mxu (same integers)."""
+    cols = [0] * out_rows
+    for i in range(NLIMB):
+        for j in range(NLIMB):
+            cols[i + j] += ba[i] * bb[j]
+    return cols
+
+
+def bound_fe_mul(curve: str, ba: Sequence[int], bb: Sequence[int],
+                 backend: str = "vpu") -> Tuple[List[int], int]:
+    """Per-row output maxima of fe_mul plus the largest intermediate the
+    pipeline can produce (callers assert < 2^32)."""
+    hi_in = max(max(ba), max(bb))
+    peak = 0
+
+    def see(vals):
+        nonlocal peak
+        peak = max(peak, max(vals))
+        return vals
+
+    if backend == "mxu":
+        # the plane split must fit its dtype: int8 (split=7) needs limbs
+        # <= 16383, uint8 (split=8) <= 65535
+        limit = 16383 if curve == "ed25519" else 65535
+        if hi_in > limit:
+            raise AssertionError(
+                f"{curve} mxu planes need limbs <= {limit}, got {hi_in}")
+    if backend == "mxu16":
+        return _bound_mul16(curve, ba, bb)
+    if curve == "ed25519":
+        cols = see(bound_mul_columns(ba, bb, 2 * NLIMB))
+        c = [b >> BITS for b in cols]
+        prod = see([min(b, MASK) + s for b, s in
+                    zip(cols, [0] + c[:-1])])
+        lo = see([prod[k] + prod[NLIMB + k] * ED_FOLD for k in range(NLIMB)])
+        for _ in range(2):
+            lo, m = _b_carry_round(lo, ((0, ED_FOLD, 0),))
+            peak = max(peak, m)
+        return lo, peak
+    if curve == "secp256k1":
+        cols = see(bound_mul_columns(ba, bb, 2 * NLIMB + 1))
+        prod = cols
+        for _ in range(3):
+            c = [b >> BITS for b in prod]
+            prod = see([min(b, MASK) + s for b, s in
+                        zip(prod, [0] + c[:-1])])
+        hi = prod[NLIMB:]  # 21 rows
+        tmp = [0] * 24
+        for k in range(NLIMB):
+            tmp[k] += prod[k]
+        for k, h in enumerate(hi):
+            tmp[k] += h * SECP_FOLD_SMALL
+            tmp[k + 2] += h << SECP_FOLD_SHIFT
+        see(tmp)
+        for _ in range(2):
+            c = [b >> BITS for b in tmp]
+            tmp = see([min(b, MASK) + s for b, s in zip(tmp, [0] + c[:-1])])
+        lo = tmp[:NLIMB]
+        for t_idx in range(4):
+            t = tmp[NLIMB + t_idx]
+            lo[t_idx] += t * SECP_FOLD_SMALL
+            lo[t_idx + 2] += t << SECP_FOLD_SHIFT
+        see(lo)
+        for _ in range(5):
+            lo, m = _b_carry_round(
+                lo, ((0, SECP_FOLD_SMALL, 0), (2, 1, SECP_FOLD_SHIFT)))
+            peak = max(peak, m)
+        return lo, peak
+    raise ValueError(curve)
+
+
+def _b_carry16(bs, rounds, wrap_terms=()):
+    """Mirror of _carry16 on per-row maxima (same top-row semantics)."""
+    seen = []
+    n = len(bs)
+    for _ in range(rounds):
+        c = [b >> 16 for b in bs]
+        nxt = [min(b, MASK16) + s for b, s in zip(bs, [0] + c[:-1])]
+        if wrap_terms:
+            for row, mult, shift in wrap_terms:
+                nxt[row] += (c[n - 1] * mult) << shift
+        else:
+            nxt[n - 1] += c[n - 1] << 16  # top row keeps its excess
+        bs = nxt
+        seen.append(max(bs))
+    return bs, max(seen)
+
+
+def _bound_mul16(curve, ba, bb) -> Tuple[List[int], int]:
+    fold13 = ED_FOLD256_13 if curve == "ed25519" else SECP_FOLD256_13
+    fold16 = ED_FOLD256_16 if curve == "ed25519" else SECP_FOLD256_16
+    peak = 0
+
+    def see(vals):
+        nonlocal peak
+        peak = max(peak, max(vals))
+        return list(vals)
+
+    def prefold(bs):
+        t = bs[NLIMB - 1] >> 9
+        out = list(bs)
+        out[NLIMB - 1] = min(out[NLIMB - 1], 0x1FF)
+        for row, mult, shift in fold13:
+            out[row] += (t * mult) << shift
+        return see(out)
+
+    def seq_carry(w):
+        for k in range(_R16 - 1):
+            c = w[k] >> 16
+            w[k] = min(w[k], MASK16)
+            w[k + 1] += c
+            see([w[k + 1]])
+        return w
+
+    def repack(bs):
+        w = [0] * _R16
+        for i in range(NLIMB):
+            q, r = divmod(BITS * i, 16)
+            w[q] += bs[i] << r
+        see(w)
+        w = seq_carry(w)
+        c = w[_R16 - 1] >> 16
+        w[_R16 - 1] = min(w[_R16 - 1], MASK16)
+        for row, mult, shift in fold16:
+            w[row] += (c * mult) << shift
+        w = seq_carry(see(w))
+        # rows end < 2^16: after the wrap the value fits 256 bits (an
+        # invariant of the prefold + wrap, not derivable from row maxima)
+        return [min(x, MASK16) for x in w]
+
+    wa = repack(prefold(ba))
+    wb = repack(prefold(bb))
+    # uint8 plane products: ll + ((lh+hl)<<8) at i+j, hh one row up
+    nrows = 2 * _R16 + 1
+    cols = [0] * nrows
+    for i in range(_R16):
+        for j in range(_R16):
+            la, ha = min(wa[i], 0xFF), wa[i] >> 8
+            lb, hb = min(wb[j], 0xFF), wb[j] >> 8
+            cols[i + j] += la * lb + ((la * hb + ha * lb) << 8)
+            cols[i + j + 1] += ha * hb
+    see(cols)
+    cols, m = _b_carry16(cols, rounds=2)
+    peak = max(peak, m)
+    # _fold16 mirror: pass one onto 16+spill rows, carry, pass two
+    spill = max(row for row, _, _ in fold16) + 1
+    lo = cols[:_R16] + [0] * spill
+    hi = cols[_R16:]
+    for row, mult, _ in fold16:
+        for j, h in enumerate(hi):
+            lo[row + j] += h * mult
+    see(lo)
+    lo, m = _b_carry16(lo, rounds=2)
+    peak = max(peak, m)
+    out16 = lo[:_R16]
+    for j in range(spill):
+        h = lo[_R16 + j]
+        for row, mult, _ in fold16:
+            out16[row + j] += h * mult
+    see(out16)
+    out16, m = _b_carry16(out16, rounds=2, wrap_terms=fold16)
+    peak = max(peak, m)
+    limbs = [0] * NLIMB
+    for k in range(_R16):
+        q, r = divmod(16 * k, BITS)
+        limbs[q] += out16[k] << r
+    see(limbs)
+    wrap = ((0, ED_FOLD, 0),) if curve == "ed25519" else (
+        (0, SECP_FOLD_SMALL, 0), (2, 1, SECP_FOLD_SHIFT))
+    rounds = 3 if curve == "ed25519" else 5
+    for _ in range(rounds):
+        limbs, m = _b_carry_round(limbs, wrap)
+        peak = max(peak, m)
+    return limbs, peak
+
+
+def bound_fe_add(curve: str, ba, bb) -> Tuple[List[int], int]:
+    x = [a + b for a, b in zip(ba, bb)]
+    peak = max(x)
+    wrap = ((0, ED_FOLD, 0),) if curve == "ed25519" else (
+        (0, SECP_FOLD_SMALL, 0), (2, 1, SECP_FOLD_SHIFT))
+    rounds = 1 if curve == "ed25519" else 3
+    for _ in range(rounds):
+        x, m = _b_carry_round(x, wrap)
+        peak = max(peak, m)
+    return x, peak
+
+
+def bound_fe_sub(curve: str, ba, bb, ksub: Sequence[int]) -> Tuple[List[int], int]:
+    # worst case ignores the subtraction (b >= 0): a + ksub
+    return bound_fe_add(curve, ba, list(ksub))
+
+
+def bound_closed_set(curve: str, backend: str = "vpu",
+                     ksub: Sequence[int] = (), iters: int = 64
+                     ) -> Tuple[List[int], int]:
+    """Fixed point of the op mix: starting from fresh-input bounds (MASK),
+    iterate max(mul, add, sub) until the per-row bounds stop growing.
+    Returns (closed-set bounds, peak intermediate).  Non-convergence or a
+    peak >= 2^32 means the op mix is unsound — the test fails."""
+    bounds = [MASK] * NLIMB
+    peak = 0
+    for _ in range(iters):
+        bm, p1 = bound_fe_mul(curve, bounds, bounds, backend)
+        ba, p2 = bound_fe_add(curve, bounds, bounds)
+        bs, p3 = (bound_fe_sub(curve, bounds, bounds, ksub)
+                  if len(ksub) else (bounds, 0))
+        nxt = [max(a, b, c) for a, b, c in zip(bm, ba, bs)]
+        peak = max(peak, p1, p2, p3)
+        if nxt == bounds:
+            return bounds, peak
+        bounds = nxt
+    raise AssertionError(f"{curve}/{backend}: carried bounds did not converge")

@@ -19,10 +19,13 @@ Differences from the bit-serial XLA kernel (768 complete adds/signature):
     removing the 256-squaring fe_inv entirely.
 
 Field arithmetic is the row-layout port of the (carry-safe) XLA ops: radix
-2^13, 20 uint32 limb rows, two-term fold 2^260 ≡ 2^36 + 15632 (mod p). The
-41-row product / 24-row fold-temp bounds mirror ops/secp256k1_verify.fe_mul
-(which documents the ripple-carry proof); parity with the host oracle over
-randomized and adversarial batches is enforced by tests/test_ops_secp256k1.
+2^13, 20 uint32 limb rows, two-term fold 2^260 ≡ 2^36 + 15632 (mod p),
+shared with the ed25519 kernel through ops/fe_common — which also provides
+the MXU int8-plane multiplier selected by the `[verify] fe_backend` knob
+(threaded through verify_batch below). Overflow bounds are recomputed
+mechanically by fe_common.bound_* and asserted in tests/test_fe_common.py;
+parity with the host oracle over randomized and adversarial batches is
+enforced by tests/test_ops_secp256k1.
 
 The host prologue is shared with the XLA kernel verbatim
 (secp256k1_verify.prep_item): strict-DER, low-s, w = s⁻¹ mod n, cached
@@ -61,75 +64,25 @@ _K_SUB = _xla._K_SUB
 
 
 # ---------------------------------------------------------------------------
-# Row-layout field ops: (20, B) blocks, batch on lanes
+# Row-layout field ops: (20, B) blocks, batch on lanes — shared with the
+# ed25519 kernel via ops/fe_common (the VPU schoolbook and the MXU int8-plane
+# multipliers live there; overflow bounds are recomputed mechanically by
+# fe_common.bound_* and asserted in tests/test_fe_common.py)
 # ---------------------------------------------------------------------------
 
+from tendermint_tpu.ops import fe_common as _fc
 
-def _shift_down(x, k=1):
-    """Rows move +k (top k rows become 0) — carries to higher limbs."""
-    return jnp.pad(x[:-k, :], ((k, 0), (0, 0)))
+_FE = {b: _fc.make_fe("secp256k1", b) for b in _fc.FE_BACKENDS}
+_FE_VPU = _FE["vpu"]
 
-
-def _wrap_top(c_top, nrows):
-    """Carry out of limb 19 (≥ 2^260) re-enters as ·15632 at row 0 and
-    << 10 at row 2. (jnp.pad placements, no scatter — Mosaic-friendly.)"""
-    return jnp.pad(c_top * FOLD_SMALL, ((0, nrows - 1), (0, 0))) + jnp.pad(
-        c_top << FOLD_SHIFT, ((2, nrows - 3), (0, 0))
-    )
-
-
-def fe_carry(x, rounds=3):
-    for _ in range(rounds):
-        c = x >> BITS
-        x = (x & MASK) + _shift_down(c) + _wrap_top(c[NLIMB - 1 :, :], NLIMB)
-    return x
-
-
-def fe_add(a, b):
-    # 3 rounds: the two-term fold can leave limbs ~3·MASK after two
-    # (same reasoning as the XLA fe_add)
-    return fe_carry(a + b, rounds=3)
-
-
-def fe_sub(a, b, ksub):
-    """ksub (20, 1): multiple-of-p constant with every limb ≥ 2·MASK."""
-    return fe_carry(a + ksub - b, rounds=3)
-
-
-def fe_mul(a, b):
-    """Row port of secp256k1_verify.fe_mul — see its docstring for the
-    41-row / 24-row ripple-carry bounds proof."""
-    terms = []
-    for i in range(NLIMB):
-        p = a[i : i + 1, :] * b  # (20, B)
-        terms.append(jnp.pad(p, ((i, NLIMB + 1 - i), (0, 0))))  # (41, B)
-    prod = sum(terms)
-    for _ in range(3):
-        c = prod >> BITS
-        prod = (prod & MASK) + _shift_down(c)
-    hi = prod[NLIMB:, :]  # (21, B)
-    # 24-row temp assembled from pads (no scatter):
-    #   rows 0..19 = lo, += hi·15632 at rows 0..20, += hi<<10 at rows 2..22
-    tmp = (
-        jnp.pad(prod[:NLIMB, :], ((0, 4), (0, 0)))
-        + jnp.pad(hi * FOLD_SMALL, ((0, 3), (0, 0)))
-        + jnp.pad(hi << FOLD_SHIFT, ((2, 1), (0, 0)))
-    )
-    for _ in range(2):
-        c = tmp >> BITS
-        tmp = (tmp & MASK) + _shift_down(c)
-    lo = tmp[:NLIMB, :]
-    for t_idx in range(4):
-        t = tmp[NLIMB + t_idx : NLIMB + t_idx + 1, :]
-        lo = lo + jnp.pad(t * FOLD_SMALL, ((t_idx, NLIMB - 1 - t_idx), (0, 0)))
-        lo = lo + jnp.pad(
-            t << FOLD_SHIFT, ((t_idx + 2, NLIMB - 3 - t_idx), (0, 0))
-        )
-    return fe_carry(lo, rounds=5)
-
-
-def fe_mul_small(a, k: int):
-    return fe_carry(a * jnp.uint32(k), rounds=4)
+# backward-compatible module-level surface (tests/test_ops_secp256k1.py and
+# the XLA kernel's parity checks import these directly)
+_shift_down = _fc.shift_rows_down
+fe_carry = _fc.secp_fe_carry
+fe_add = _fc.secp_fe_add
+fe_sub = _fc.secp_fe_sub
+fe_mul = _fc.secp_fe_mul
+fe_mul_small = _fc.secp_fe_mul_small
 
 
 # ---------------------------------------------------------------------------
@@ -138,26 +91,26 @@ def fe_mul_small(a, k: int):
 # ---------------------------------------------------------------------------
 
 
-def pt_add(p, q, ksub):
+def pt_add(p, q, ksub, fe=_FE_VPU):
     X1, Y1, Z1 = p
     X2, Y2, Z2 = q
-    t0 = fe_mul(X1, X2)
-    t1 = fe_mul(Y1, Y2)
-    t2 = fe_mul(Z1, Z2)
-    t3 = fe_mul(fe_add(X1, Y1), fe_add(X2, Y2))
-    t3 = fe_sub(t3, fe_add(t0, t1), ksub)
-    t4 = fe_mul(fe_add(Y1, Z1), fe_add(Y2, Z2))
-    t4 = fe_sub(t4, fe_add(t1, t2), ksub)
-    X3 = fe_mul(fe_add(X1, Z1), fe_add(X2, Z2))
-    Y3 = fe_sub(X3, fe_add(t0, t2), ksub)
-    t0x3 = fe_add(fe_add(t0, t0), t0)
-    t2b = fe_mul_small(t2, B3)
-    Z3 = fe_add(t1, t2b)
-    t1 = fe_sub(t1, t2b, ksub)
-    Y3b = fe_mul_small(Y3, B3)
-    X3 = fe_sub(fe_mul(t3, t1), fe_mul(t4, Y3b), ksub)
-    Y3 = fe_add(fe_mul(Y3b, t0x3), fe_mul(t1, Z3))
-    Z3 = fe_add(fe_mul(Z3, t4), fe_mul(t0x3, t3))
+    t0 = fe.mul(X1, X2)
+    t1 = fe.mul(Y1, Y2)
+    t2 = fe.mul(Z1, Z2)
+    t3 = fe.mul(fe.add(X1, Y1), fe.add(X2, Y2))
+    t3 = fe.sub(t3, fe.add(t0, t1), ksub)
+    t4 = fe.mul(fe.add(Y1, Z1), fe.add(Y2, Z2))
+    t4 = fe.sub(t4, fe.add(t1, t2), ksub)
+    X3 = fe.mul(fe.add(X1, Z1), fe.add(X2, Z2))
+    Y3 = fe.sub(X3, fe.add(t0, t2), ksub)
+    t0x3 = fe.add(fe.add(t0, t0), t0)
+    t2b = fe.mul_small(t2, B3)
+    Z3 = fe.add(t1, t2b)
+    t1 = fe.sub(t1, t2b, ksub)
+    Y3b = fe.mul_small(Y3, B3)
+    X3 = fe.sub(fe.mul(t3, t1), fe.mul(t4, Y3b), ksub)
+    Y3 = fe.add(fe.mul(Y3b, t0x3), fe.mul(t1, Z3))
+    Z3 = fe.add(fe.mul(Z3, t4), fe.mul(t0x3, t3))
     return X3, Y3, Z3
 
 
@@ -227,14 +180,16 @@ def _canonical_ref(v, s1, s2):
 
 
 def ladder_math(consts, qx, qy, dig1_get, dig2_get, nwin: int = NWIN,
-                loop=lax.fori_loop):
+                loop=lax.fori_loop, fe_backend: str = "vpu"):
     """The windowed-Straus double-scalar multiply u1·G + u2·Q — pure jnp,
     shared by the pallas kernel (on ref values) and the CPU parity tests.
     dig1_get/dig2_get: t -> (1, B) digit row accessors (a ref slice
     in-kernel, an array row in tests). nwin < NWIN drives the identical
     code with small scalars, and tests swap `loop` for a plain Python loop
     to evaluate eagerly (XLA's CPU compile of this graph thrashes for
-    ~10 min in the simplifier). Returns projective (X, Y, Z)."""
+    ~10 min in the simplifier). fe_backend picks the limb multiplier
+    (fe_common.FE_BACKENDS). Returns projective (X, Y, Z)."""
+    fe = _FE[fe_backend]
     B = qx.shape[1]
     zero = jnp.zeros((NLIMB, B), jnp.uint32)
     one = jnp.pad(jnp.ones((1, B), jnp.uint32), ((0, NLIMB - 1), (0, 0)))
@@ -247,7 +202,7 @@ def ladder_math(consts, qx, qy, dig1_get, dig2_get, nwin: int = NWIN,
     # identity at j=0, so tbl[1] = ident + Q = Q needs no special case
     tbl = [ident]
     for j in range(1, 16):
-        tbl.append(pt_add(tbl[j - 1], q1, ksub))
+        tbl.append(pt_add(tbl[j - 1], q1, ksub, fe))
     tbl_x = jnp.stack([t[0] for t in tbl])  # (16, 20, B)
     tbl_y = jnp.stack([t[1] for t in tbl])
     tbl_z = jnp.stack([t[2] for t in tbl])
@@ -260,7 +215,7 @@ def ladder_math(consts, qx, qy, dig1_get, dig2_get, nwin: int = NWIN,
 
     def body(t, acc):
         for _ in range(4):
-            acc = pt_add(acc, acc, ksub)  # the complete law doubles too
+            acc = pt_add(acc, acc, ksub, fe)  # the complete law doubles too
         d1 = dig1_get(t)  # (1, B)
         d2 = dig2_get(t)
         mk1 = [(d1 == j).astype(jnp.uint32) for j in range(16)]
@@ -268,17 +223,18 @@ def ladder_math(consts, qx, qy, dig1_get, dig2_get, nwin: int = NWIN,
         gx = sum(consts[:, j : j + 1] * mk1[j] for j in range(16))
         gy = sum(consts[:, 16 + j : 17 + j] * mk1[j] for j in range(16))
         gz = sum(consts[:, 32 + j : 33 + j] * mk1[j] for j in range(16))
-        acc = pt_add(acc, (gx, gy, gz), ksub)
+        acc = pt_add(acc, (gx, gy, gz), ksub, fe)
         q_sel = (select16(tbl_x, mk2), select16(tbl_y, mk2),
                  select16(tbl_z, mk2))
-        acc = pt_add(acc, q_sel, ksub)
+        acc = pt_add(acc, q_sel, ksub, fe)
         return acc
 
     return loop(0, nwin, body, ident)
 
 
 def _ladder_kernel(consts_ref, qx_ref, qy_ref, dig1_ref, dig2_ref,
-                   rl_ref, rnl_ref, rnok_ref, out_ref, s1, s2):
+                   rl_ref, rnl_ref, rnok_ref, out_ref, s1, s2,
+                   fe_backend: str = "vpu"):
     consts = consts_ref[:]
     ksub = consts[:, 48:49]
     X, _Y, Z = ladder_math(
@@ -286,20 +242,22 @@ def _ladder_kernel(consts_ref, qx_ref, qy_ref, dig1_ref, dig2_ref,
         lambda t: dig1_ref[pl.ds(t, 1), :],
         lambda t: dig2_ref[pl.ds(t, 1), :],
         nwin=dig1_ref.shape[0],
+        fe_backend=fe_backend,
     )
 
+    fe = _FE[fe_backend]
     z_can = _canonical_ref(Z, s1, s2)
     nonzero = jnp.any(z_can != 0, axis=0, keepdims=True)
     # x(R) ≡ r  ⇔  X ≡ r·Z  (Z ≠ 0); same for the r+n representative
-    d_r = _canonical_ref(fe_sub(X, fe_mul(rl_ref[:], Z), ksub), s1, s2)
+    d_r = _canonical_ref(fe.sub(X, fe.mul(rl_ref[:], Z), ksub), s1, s2)
     eq_r = jnp.all(d_r == 0, axis=0, keepdims=True)
-    d_rn = _canonical_ref(fe_sub(X, fe_mul(rnl_ref[:], Z), ksub), s1, s2)
+    d_rn = _canonical_ref(fe.sub(X, fe.mul(rnl_ref[:], Z), ksub), s1, s2)
     eq_rn = jnp.all(d_rn == 0, axis=0, keepdims=True) & (rnok_ref[:] != 0)
     out_ref[:] = (nonzero & (eq_r | eq_rn)).astype(jnp.uint32)
 
 
 def _ladder_call(qx, qy, dig1, dig2, rl, rnl, rnok, *, interpret=False,
-                 lanes=LANES):
+                 lanes=LANES, fe_backend="vpu"):
     """qx/qy/rl/rnl (20, N); dig1/dig2 (nwin, N) — NWIN=64 in production,
     fewer in the reduced interpret tests; rnok (1, N); N % lanes == 0."""
     n = qx.shape[1]
@@ -309,7 +267,7 @@ def _ladder_call(qx, qy, dig1, dig2, rl, rnl, rnok, *, interpret=False,
     spec64 = pl.BlockSpec((nwin, lanes), lambda i: (0, i), memory_space=pltpu.VMEM)
     spec1 = pl.BlockSpec((1, lanes), lambda i: (0, i), memory_space=pltpu.VMEM)
     return pl.pallas_call(
-        _ladder_kernel,
+        partial(_ladder_kernel, fe_backend=fe_backend),
         out_shape=jax.ShapeDtypeStruct((1, n), jnp.uint32),
         grid=(n // lanes,),
         in_specs=[cspec, spec20, spec20, spec64, spec64, spec20, spec20, spec1],
@@ -321,9 +279,9 @@ def _ladder_call(qx, qy, dig1, dig2, rl, rnl, rnok, *, interpret=False,
 
 _CONSTS = _build_g_table()
 
-_ladder_jit = partial(jax.jit, static_argnames=("interpret", "lanes"))(
-    _ladder_call
-)
+_ladder_jit = partial(
+    jax.jit, static_argnames=("interpret", "lanes", "fe_backend")
+)(_ladder_call)
 
 
 # ---------------------------------------------------------------------------
@@ -349,9 +307,12 @@ def verify_batch(
     sigs: Sequence[bytes],
     interpret: bool = False,
     device=None,
+    fe_backend: str = "vpu",
 ) -> np.ndarray:
     """Batched ECDSA verify on the Pallas path — same contract (and the
-    same host prologue) as secp256k1_verify.verify_batch."""
+    same host prologue) as secp256k1_verify.verify_batch. `fe_backend`
+    selects the limb multiplier (fe_common.FE_BACKENDS); bit-exact."""
+    fe_backend = _fc.normalize_backend(fe_backend)
     n = len(pubkeys)
     if n == 0:
         return np.zeros((0,), dtype=bool)
@@ -385,9 +346,14 @@ def verify_batch(
     args = [put(np.ascontiguousarray(a.T)) for a in (qx, qy, d1, d2, rl, rnl)]
     args.append(put(rnok[None, :]))
     if interpret:
-        ok = np.asarray(_ladder_call(*args, interpret=True, lanes=lanes))[0, :n]
+        ok = np.asarray(
+            _ladder_call(*args, interpret=True, lanes=lanes,
+                         fe_backend=fe_backend)
+        )[0, :n]
     else:
-        ok = np.asarray(_ladder_jit(*args, lanes=lanes))[0, :n]
+        ok = np.asarray(
+            _ladder_jit(*args, lanes=lanes, fe_backend=fe_backend)
+        )[0, :n]
 
     f = forced[:n]
     return np.where(f >= 0, f.astype(bool), ok.astype(bool))

@@ -24,6 +24,7 @@ Accept/reject is bit-exact with crypto/secp256k1.verify (the host oracle).
 
 from __future__ import annotations
 
+import sys
 from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -33,6 +34,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from tendermint_tpu.crypto import secp256k1 as _s
+from tendermint_tpu.ops import fe_common as _fc
 
 P = _s.P
 N = _s.N
@@ -118,6 +120,14 @@ def fe_sub(a, b):
     return fe_carry(a + _K_SUB - b, rounds=3)
 
 
+# Limb-multiplier backend, same trace-time mechanism as ed25519_verify:
+# "mxu" swaps only the column computation for fe_common.mul_columns_batch
+# (4 uint8-plane matmuls, split=8 — secp's carried limb 0 can exceed the
+# int8 plane bound; see fe_common._columns_mxu_rows). Set exclusively by
+# _compiled_kernel's wrapper; the jit cache is keyed on it.
+_FE_BACKEND = "vpu"
+
+
 def fe_mul(a, b):
     """Bounds (limbs of carried inputs ≤ M = 13000, columns ≤ 20·M² < 2^32):
 
@@ -135,9 +145,12 @@ def fe_mul(a, b):
     into lo with FULL values (≤ 8200·15632 < 2^27 — nothing masked away).
     """
     shape = jnp.broadcast_shapes(a.shape[:-1], b.shape[:-1])
-    prod = jnp.zeros(shape + (2 * NLIMB + 1,), dtype=jnp.uint32)
-    for i in range(NLIMB):
-        prod = prod.at[..., i : i + NLIMB].add(a[..., i : i + 1] * b)
+    if _FE_BACKEND != "vpu":
+        prod = _fc.mul_columns_batch(a, b, 2 * NLIMB + 1, split=8)
+    else:
+        prod = jnp.zeros(shape + (2 * NLIMB + 1,), dtype=jnp.uint32)
+        for i in range(NLIMB):
+            prod = prod.at[..., i : i + NLIMB].add(a[..., i : i + 1] * b)
     for _ in range(3):
         c = prod >> BITS
         prod = (prod & MASK).at[..., 1:].add(c[..., :-1])
@@ -290,17 +303,22 @@ def _verify_kernel(qx, qy, u1_words, u2_words, r_limbs, rn_limbs, rn_ok):
 _kernel_cache: dict = {}
 
 
-def _compiled_kernel(batch: int, mesh=None):
-    key = (batch, mesh)
+def _compiled_kernel(batch: int, mesh=None, fe_backend: str = "vpu"):
+    if fe_backend not in ("vpu", "mxu"):
+        fe_backend = "mxu" if fe_backend == "mxu16" else "vpu"
+    key = (batch, mesh, fe_backend)
     fn = _kernel_cache.get(key)
     if fn is None:
+        kernel = _fc.trace_with_backend(
+            sys.modules[__name__], _verify_kernel, fe_backend
+        )
         if mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as PS
 
             data = NamedSharding(mesh, PS(mesh.axis_names[0]))
-            fn = jax.jit(_verify_kernel, in_shardings=(data,) * 7, out_shardings=data)
+            fn = jax.jit(kernel, in_shardings=(data,) * 7, out_shardings=data)
         else:
-            fn = jax.jit(_verify_kernel)
+            fn = jax.jit(kernel)
         _kernel_cache[key] = fn
     return fn
 
@@ -378,9 +396,12 @@ def verify_batch(
     digests: Sequence[bytes],
     sigs: Sequence[bytes],
     mesh=None,
+    fe_backend: str = "vpu",
 ) -> np.ndarray:
     """Batched ECDSA verify; bit-exact with crypto/secp256k1.verify.
-    pubkeys: 33-byte compressed; digests: 32 bytes; sigs: DER."""
+    pubkeys: 33-byte compressed; digests: 32 bytes; sigs: DER.
+    fe_backend: "vpu" | "mxu" limb multiplier ("mxu16" degrades to "mxu")."""
+    fe_backend = _fc.normalize_backend(fe_backend)
     n = len(pubkeys)
     if n == 0:
         return np.zeros((0,), dtype=bool)
@@ -410,7 +431,7 @@ def verify_batch(
             rnl[i] = int_to_limbs(r + N)
             rn_ok[i] = True
 
-    kernel = _compiled_kernel(b, mesh)
+    kernel = _compiled_kernel(b, mesh, fe_backend)
     host = (qx, qy, u1w, u2w, rl, rnl, rn_ok)
     if mesh is not None:
         # device_put the *numpy* arrays straight onto the mesh sharding: an

@@ -142,13 +142,19 @@ _step_cache = {}
 _compiled_shapes = set()
 
 
-def _compiled_step(mesh):
-    key = mesh  # Mesh hashes by devices+axis_names; id() could be gc-reused
+def _compiled_step(mesh, fe_backend: str = "vpu"):
+    from tendermint_tpu.ops import fe_common as _fc
+
+    # the XLA kernel has no mxu16 lowering — degrade to the plane multiplier
+    fe_backend = "mxu" if fe_backend in ("mxu", "mxu16") else "vpu"
+    # Mesh hashes by devices+axis_names; id() could be gc-reused
+    key = (mesh, fe_backend)
     fn = _step_cache.get(key)
     if fn is not None:
         return fn
+    step = _fc.trace_with_backend(_k, _step, fe_backend)
     if mesh is None:
-        fn = jax.jit(_step)
+        fn = jax.jit(step)
     else:
         from jax.sharding import NamedSharding, PartitionSpec as PS
 
@@ -157,7 +163,7 @@ def _compiled_step(mesh):
         h_only = NamedSharding(mesh, PS(hname))
         rep = NamedSharding(mesh, PS())
         fn = jax.jit(
-            _step,
+            step,
             in_shardings=(hv,) * 8 + (rep,),
             out_shardings=(hv, h_only, h_only),
         )
@@ -345,7 +351,10 @@ def _verify_window_device(
     # consensus-safety bug.  Scope the flag to this dispatch instead of
     # flipping global dtype semantics for the whole process at import time.
     backend = "window_mesh" if mesh is not None else "window"
-    shape_key = (mesh, (ph, pv))
+    from tendermint_tpu.crypto.batch import _resolve_fe_backend
+
+    fe_backend = _resolve_fe_backend(None)
+    shape_key = (mesh, (ph, pv), fe_backend)
     first = shape_key not in _compiled_shapes
     _compiled_shapes.add(shape_key)
     n = int(np.count_nonzero(win.present))
@@ -357,7 +366,7 @@ def _verify_window_device(
 
                 hv = NamedSharding(mesh, PS(*mesh.axis_names[:2]))
                 arrs = [jax.device_put(a, hv) for a in arrs]
-            ok, tally, committed = _compiled_step(mesh)(
+            ok, tally, committed = _compiled_step(mesh, fe_backend)(
                 *arrs, np.int64(total_power)
             )
             ok = np.asarray(ok)[:H, :V]
@@ -368,6 +377,7 @@ def _verify_window_device(
         get_verify_metrics().record_dispatch(
             backend, "ed25519", n, dt,
             rejects=int(np.count_nonzero(win.present & ~ok)), first=first,
+            fe_backend=fe_backend,
         )
         get_profiler().record(
             backend,
@@ -379,6 +389,7 @@ def _verify_window_device(
             run_seconds=dt,
             compiled=first,
             bytes_to_device=sum(a.nbytes for a in arrs),
+            fe_backend=fe_backend,
         )
     except Exception:
         pass

@@ -319,20 +319,26 @@ def reset_cache() -> None:
         _compiles = 0
 
 
-def _compiled_step(mesh, B: int, S: int):
-    """jit'd step for one (mesh, lane bucket, seg bucket); returns
-    (fn, compiled) where compiled marks a cache miss (a real jit trace —
-    padded shapes are fixed per bucket, so key miss == recompile)."""
+def _compiled_step(mesh, B: int, S: int, fe_backend: str = "vpu"):
+    """jit'd step for one (mesh, lane bucket, seg bucket, fe backend);
+    returns (fn, compiled) where compiled marks a cache miss (a real jit
+    trace — padded shapes are fixed per bucket, so key miss == recompile)."""
     global _compiles
     import jax
 
-    key = (mesh, B, S)
+    from tendermint_tpu.ops import ed25519_verify as _k
+    from tendermint_tpu.ops import fe_common as _fc
+
+    # the XLA kernel has no mxu16 lowering — degrade to the plane multiplier
+    fe_backend = "mxu" if fe_backend in ("mxu", "mxu16") else "vpu"
+    key = (mesh, B, S, fe_backend)
     with _cache_mtx:
         fn = _step_cache.get(key)
         if fn is not None:
             return fn, False
+        step = _fc.trace_with_backend(_k, _planner_step, fe_backend)
         if mesh is None:
-            fn = jax.jit(_planner_step)
+            fn = jax.jit(step)
         else:
             from jax.sharding import NamedSharding, PartitionSpec as PS
 
@@ -342,7 +348,7 @@ def _compiled_step(mesh, B: int, S: int):
             lane = NamedSharding(mesh, PS(tuple(mesh.axis_names)))
             rep = NamedSharding(mesh, PS())
             fn = jax.jit(
-                _planner_step,
+                step,
                 in_shardings=(lane,) * 10 + (rep,),
                 out_shardings=(lane, rep, rep, rep),
             )
@@ -357,7 +363,10 @@ def _execute_device(plan: WindowPlan, mesh=None) -> WindowVerdict:
     pack_device(plan, mesh)
     B, S = plan.dev_shape
     n = plan.n_lanes
-    fn, compiled = _compiled_step(mesh, B, S)
+    from tendermint_tpu.crypto.batch import _resolve_fe_backend
+
+    fe_backend = _resolve_fe_backend(None)
+    fn, compiled = _compiled_step(mesh, B, S, fe_backend)
     t0 = time.perf_counter()
     backend = "planner_mesh" if mesh is not None else "planner"
     with trace.span(
@@ -392,6 +401,7 @@ def _execute_device(plan: WindowPlan, mesh=None) -> WindowVerdict:
             backend, "ed25519", n, dt,
             rejects=int(np.count_nonzero(plan.dev[6][:n] & ~ok_l)),
             first=compiled,
+            fe_backend=fe_backend,
         )
         get_profiler().record(
             backend,
@@ -403,6 +413,7 @@ def _execute_device(plan: WindowPlan, mesh=None) -> WindowVerdict:
             run_seconds=dt,
             compiled=compiled,
             bytes_to_device=sum(a.nbytes for a in plan.dev),
+            fe_backend=fe_backend,
         )
     except Exception:
         pass

@@ -1,0 +1,267 @@
+"""Property tests for the shared field arithmetic in ops/fe_common.py.
+
+Every fe op (mul / sq / add / sub / carry / inv) on every backend
+(vpu / mxu / mxu16) for both curves is checked against a Python-bignum
+reference, over random limb vectors plus the adversarial patterns the
+ISSUE calls out: all-ones 13-bit limbs, p-1, p, p+1, and inputs held at
+the closed-set carried maxima (the largest limbs any op chain can
+produce).  Runs entirely eagerly under JAX_PLATFORMS=cpu — tier-1.
+
+The bounds section replaces the hand-stated overflow analysis that used
+to live in the ed25519_pallas header comment: fe_common.bound_*
+re-derives, mechanically, that the op mix is closed (carried limbs stay
+under each backend's plane limit) and that no intermediate reaches
+2^32.  If a future edit to the carry/fold chains breaks either claim,
+these tests fail instead of a comment going stale.
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from tendermint_tpu.ops import fe_common as fc  # noqa: E402
+from tendermint_tpu.ops import ed25519_verify as ed_xla  # noqa: E402
+from tendermint_tpu.ops import secp256k1_verify as sp_xla  # noqa: E402
+
+NLIMB, BITS, MASK = fc.NLIMB, fc.BITS, fc.MASK
+
+CURVES = {
+    "ed25519": {"p": fc.ED_P, "ksub": np.asarray(ed_xla._K_SUB)},
+    "secp256k1": {"p": fc.SECP_P, "ksub": np.asarray(sp_xla._K_SUB)},
+}
+
+
+def to_limbs(x: int) -> np.ndarray:
+    out = np.zeros(NLIMB, dtype=np.uint32)
+    for i in range(NLIMB):
+        out[i] = (x >> (BITS * i)) & MASK
+    return out
+
+
+def from_limbs(l) -> int:
+    return sum(int(v) << (BITS * i) for i, v in enumerate(np.asarray(l)))
+
+
+def _lanes(cols):
+    """Stack 1-D limb vectors into the kernels' (NLIMB, B) row layout."""
+    return jnp.asarray(np.stack(cols, axis=-1).astype(np.uint32))
+
+
+def _ksub_col(curve):
+    return jnp.asarray(
+        CURVES[curve]["ksub"].reshape(NLIMB, 1).astype(np.uint32)
+    )
+
+
+def _inputs(curve, rng, n_random=6):
+    """Limb vectors spanning the whole legal input space: canonical
+    values (random, 0, 1, p-1, p, p+1, 2^256-1), the all-ones fresh
+    bound (every limb = MASK), and the closed-set carried maxima."""
+    p = CURVES[curve]["p"]
+    vals = [0, 1, p - 1, p, p + 1, (1 << 256) - 1]
+    vals += [int(rng.integers(0, 1 << 62)) ** 5 % p for _ in range(n_random)]
+    cols = [to_limbs(v) for v in vals]
+    cols.append(np.full(NLIMB, MASK, dtype=np.uint32))
+    ksub = CURVES[curve]["ksub"]
+    bounds, _ = fc.bound_closed_set(curve, "vpu", ksub=list(ksub))
+    cols.append(np.asarray(bounds, dtype=np.uint32))
+    # random carried-form inputs up to the closed-set bound per row
+    for _ in range(n_random):
+        cols.append(
+            rng.integers(0, np.asarray(bounds) + 1, NLIMB).astype(np.uint32)
+        )
+    return cols
+
+
+@pytest.mark.parametrize("curve", list(CURVES))
+@pytest.mark.parametrize("backend", fc.FE_BACKENDS)
+class TestFeOpsVsBignum:
+    def test_mul_sq(self, curve, backend):
+        p = CURVES[curve]["p"]
+        fe = fc.make_fe(curve, backend)
+        rng = np.random.default_rng(7)
+        cols = _inputs(curve, rng)
+        a = _lanes(cols)
+        b = _lanes(cols[::-1])
+        got = np.asarray(fe.mul(a, b))
+        sq = np.asarray(fe.sq(a))
+        for k in range(a.shape[1]):
+            va, vb = from_limbs(cols[k]), from_limbs(cols[::-1][k])
+            assert from_limbs(got[:, k]) % p == (va * vb) % p, (
+                curve, backend, "mul", k)
+            assert from_limbs(sq[:, k]) % p == (va * va) % p, (
+                curve, backend, "sq", k)
+
+    def test_add_sub_carry(self, curve, backend):
+        # add/sub/carry are backend-independent VPU chains, but run them
+        # under every backend namespace anyway: make_fe must wire the
+        # same functions regardless of the mul backend chosen
+        p = CURVES[curve]["p"]
+        fe = fc.make_fe(curve, backend)
+        rng = np.random.default_rng(11)
+        cols = _inputs(curve, rng)
+        a = _lanes(cols)
+        b = _lanes(cols[::-1])
+        ksub = _ksub_col(curve)
+        got_add = np.asarray(fe.add(a, b))
+        got_sub = np.asarray(fe.sub(a, b, ksub))
+        got_carry = np.asarray(fe.carry(a))
+        for k in range(a.shape[1]):
+            va, vb = from_limbs(cols[k]), from_limbs(cols[::-1][k])
+            assert from_limbs(got_add[:, k]) % p == (va + vb) % p, (
+                curve, backend, "add", k)
+            assert from_limbs(got_sub[:, k]) % p == (va - vb) % p, (
+                curve, backend, "sub", k)
+            assert from_limbs(got_carry[:, k]) % p == va % p, (
+                curve, backend, "carry", k)
+
+    def test_inv(self, curve, backend):
+        import os
+
+        if backend == "mxu16" and not os.environ.get("TM_RUN_SLOW"):
+            # ~250 eager muls through the radix-2^16 repack is minutes on
+            # CPU; mul/sq/add/sub/carry still cover mxu16 in tier-1
+            pytest.skip("mxu16 inv is slow eagerly (set TM_RUN_SLOW=1)")
+        p = CURVES[curve]["p"]
+        fe = fc.make_fe(curve, backend)
+        rng = np.random.default_rng(13)
+        vals = [1, 2, p - 1, int(rng.integers(2, 1 << 61)) ** 4 % p]
+        cols = [to_limbs(v) for v in vals]
+        got = np.asarray(fe.inv(_lanes(cols)))
+        for k, v in enumerate(vals):
+            assert from_limbs(got[:, k]) % p == pow(v, p - 2, p), (
+                curve, backend, "inv", k)
+
+    def test_mul_small(self, curve, backend):
+        if curve != "secp256k1":
+            pytest.skip("mul_small is a secp-only op (B3 = 21)")
+        p = CURVES[curve]["p"]
+        fe = fc.make_fe(curve, backend)
+        rng = np.random.default_rng(17)
+        cols = _inputs(curve, rng)
+        got = np.asarray(fe.mul_small(_lanes(cols), 21))
+        for k, c in enumerate(cols):
+            assert from_limbs(got[:, k]) % p == (from_limbs(c) * 21) % p
+
+
+class TestBatchLayout:
+    """The XLA kernels use the batch-leading (..., NLIMB) layout through
+    mul_columns_batch; its columns must be the exact schoolbook integers
+    (the carry tails downstream assume identical column values)."""
+
+    @pytest.mark.parametrize("curve,split", [("ed25519", 7), ("secp256k1", 8)])
+    def test_columns_match_schoolbook(self, curve, split):
+        rng = np.random.default_rng(19)
+        ksub = CURVES[curve]["ksub"]
+        bounds, _ = fc.bound_closed_set(curve, "vpu", ksub=list(ksub))
+        hi = np.asarray(bounds, dtype=np.uint64)
+        for shape in ((4, NLIMB), (2, 3, NLIMB)):
+            a = rng.integers(0, hi + 1, shape).astype(np.uint32)
+            b = rng.integers(0, hi + 1, shape).astype(np.uint32)
+            out = 2 * NLIMB + 1
+            got = np.asarray(
+                fc.mul_columns_batch(jnp.asarray(a), jnp.asarray(b), out,
+                                     split=split)
+            ).astype(np.uint64)
+            want = np.zeros(shape[:-1] + (out,), dtype=np.uint64)
+            for i in range(NLIMB):
+                want[..., i:i + NLIMB] += (
+                    a[..., i:i + 1].astype(np.uint64) * b
+                )
+            # columns are equal as uint32 integers (mod 2^32 — the bound
+            # tests prove nothing actually wraps in the kernels' range)
+            np.testing.assert_array_equal(got & 0xFFFFFFFF,
+                                          want & 0xFFFFFFFF)
+
+    def test_constant_operand_broadcasts(self, curve="ed25519"):
+        # pt_add multiplies by (NLIMB, 1) constants (d2, ksub); the MXU
+        # path must broadcast them against (NLIMB, B) like the VPU does
+        p = CURVES[curve]["p"]
+        rng = np.random.default_rng(23)
+        a = rng.integers(0, MASK + 1, (NLIMB, 5)).astype(np.uint32)
+        c = rng.integers(0, MASK + 1, (NLIMB, 1)).astype(np.uint32)
+        for backend in fc.FE_BACKENDS:
+            fe = fc.make_fe(curve, backend)
+            got = np.asarray(fe.mul(jnp.asarray(a), jnp.asarray(c)))
+            vc = from_limbs(c[:, 0])
+            for k in range(a.shape[1]):
+                assert from_limbs(got[:, k]) % p == (
+                    from_limbs(a[:, k]) * vc) % p, (backend, k)
+
+
+class TestXlaKernelFeMul:
+    """The trace-time _FE_BACKEND switch in the XLA kernel modules: the
+    mxu branch of fe_mul must be bit-identical (not just congruent) to
+    the vpu branch, since the audit path compares encodings."""
+
+    @pytest.mark.parametrize("mod,curve", [(ed_xla, "ed25519"),
+                                           (sp_xla, "secp256k1")])
+    def test_bit_identical(self, mod, curve):
+        rng = np.random.default_rng(29)
+        ksub = CURVES[curve]["ksub"]
+        bounds, _ = fc.bound_closed_set(curve, "vpu", ksub=list(ksub))
+        hi = np.asarray(bounds, dtype=np.uint64)
+        a = jnp.asarray(rng.integers(0, hi + 1, (6, NLIMB)).astype(np.uint32))
+        b = jnp.asarray(rng.integers(0, hi + 1, (6, NLIMB)).astype(np.uint32))
+        base = np.asarray(mod.fe_mul(a, b))
+        wrapped = fc.trace_with_backend(mod, mod.fe_mul, "mxu")
+        np.testing.assert_array_equal(np.asarray(wrapped(a, b)), base)
+        assert mod._FE_BACKEND == "vpu"  # wrapper must restore
+
+
+class TestBounds:
+    """Mechanical re-proof of the overflow claims (replaces the stale
+    hand-written block that used to sit atop ops/ed25519_pallas.py)."""
+
+    @pytest.mark.parametrize("curve", list(CURVES))
+    @pytest.mark.parametrize("backend", fc.FE_BACKENDS)
+    def test_closed_set_converges_below_2_32(self, curve, backend):
+        ksub = list(CURVES[curve]["ksub"])
+        bounds, peak = fc.bound_closed_set(curve, backend, ksub=ksub)
+        assert peak < 1 << 32, (curve, backend, peak)
+        # closure: one more round of every op stays within the fixed point
+        bm, _ = fc.bound_fe_mul(curve, bounds, bounds, backend)
+        ba, _ = fc.bound_fe_add(curve, bounds, bounds)
+        bs, _ = fc.bound_fe_sub(curve, bounds, bounds, ksub)
+        for nxt in (bm, ba, bs):
+            assert all(x <= y for x, y in zip(nxt, bounds)), (curve, backend)
+
+    def test_plane_limits_hold_on_closed_set(self):
+        # the int8 (ed, split=7) and uint8 (secp, split=8) plane splits
+        # require carried limbs <= 16383 / 65535; the closed set must
+        # stay under those or the MXU planes silently truncate
+        for curve, limit in (("ed25519", 16383), ("secp256k1", 65535)):
+            ksub = list(CURVES[curve]["ksub"])
+            bounds, _ = fc.bound_closed_set(curve, "vpu", ksub=ksub)
+            assert max(bounds) <= limit, (curve, max(bounds))
+
+    def test_plane_limit_violation_raises(self):
+        # ed25519 limbs past the int8 plane bound must be rejected, not
+        # silently mis-multiplied
+        with pytest.raises(AssertionError):
+            fc.bound_fe_mul("ed25519", [16384] * NLIMB, [1] * NLIMB, "mxu")
+
+    def test_ed25519_41st_product_row_required(self):
+        # regression pin for the top-carry drop: no direct product reaches
+        # column 40 (i + j <= 38), but near-bound inputs overflow column 38
+        # and the carry ripples one row per round — a 40-limb buffer would
+        # silently drop the carry out of row 39
+        cols = fc.bound_mul_columns([13000] * NLIMB, [13000] * NLIMB,
+                                    2 * NLIMB + 1)
+        assert cols[2 * NLIMB] == 0
+        bs = cols
+        for _ in range(3):
+            c = [b >> BITS for b in bs]
+            bs = [min(b, MASK) + s for b, s in zip(bs, [0] + c[:-1])]
+        assert bs[2 * NLIMB] > 0
+
+    def test_normalize_backend(self):
+        assert fc.normalize_backend(None) == "vpu"
+        assert fc.normalize_backend("") == "vpu"
+        assert fc.normalize_backend("auto") == "vpu"
+        assert fc.normalize_backend("MXU") == "mxu"
+        assert fc.normalize_backend(" mxu16 ") == "mxu16"
+        with pytest.raises(ValueError):
+            fc.normalize_backend("gpu")
