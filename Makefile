@@ -34,16 +34,19 @@ planner-bench:
 
 # batched-verify throughput with the selected limb multiplier
 # (FE_BACKEND=vpu|mxu|mxu16); appends a round under build/pallas_bench and
-# gates ed25519_sigs_per_s (higher-is-better) against the previous round.
-# Uses the Pallas kernel when the TPU tunnel is up, else the XLA kernel on
-# the local backend — end-to-end runnable on JAX_PLATFORMS=cpu.
+# gates ed25519_sigs_per_s (higher-is-better) plus the per-window ladder
+# slope (lower-is-better — the carry-schedule regression gate) against the
+# previous round.  Uses the Pallas kernel when the TPU tunnel is up, else
+# the XLA kernel on the local backend — end-to-end runnable on
+# JAX_PLATFORMS=cpu.
 FE_BACKEND ?= vpu
 pallas-bench:
 	$(PYTHON) scripts/profile_pallas.py \
 	  --fe-backend $(FE_BACKEND) --round-dir build/pallas_bench \
 	  --metrics-out build/pallas_bench/verify_metrics.prom $(ARGS)
 	$(PYTHON) scripts/bench_check.py --dir build/pallas_bench \
-	  --metric "ed25519_sigs_per_s$(if $(filter-out vpu,$(FE_BACKEND)),_$(FE_BACKEND)):0.25:higher"
+	  --metric "ed25519_sigs_per_s$(if $(filter-out vpu,$(FE_BACKEND)),_$(FE_BACKEND)):0.25:higher" \
+	  --metric "pallas_ladder_window_slope$(if $(filter-out vpu,$(FE_BACKEND)),_$(FE_BACKEND)):0.25:lower"
 
 bench_secp:
 	$(PYTHON) scripts/bench_secp.py 1024

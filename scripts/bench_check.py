@@ -42,6 +42,10 @@ DEFAULT_METRICS = [
     "mempool_checktx_per_s:0.25:higher",
     # batched-verify headline (scripts/profile_pallas.py / make pallas-bench)
     "ed25519_sigs_per_s:0.25:higher",
+    # per-window ladder cost (ms/window) — the carry-schedule regression
+    # gate: the windowed point ops are where the deferred-carry pool
+    # lives, so a lazy-carry regression moves this slope first
+    "pallas_ladder_window_slope:0.25:lower",
 ]
 DEFAULT_THRESHOLD = 0.20
 

@@ -292,8 +292,10 @@ def _self_check():
 
     vm = VerifyMetrics()
     vm.record_dispatch("host", "ed25519", 64, 0.012, rejects=1, first=True)
-    vm.record_dispatch("xla", "secp256k1", 128, 0.3, fe_backend="mxu")
-    vm.record_dispatch("pallas", "ed25519", 256, 0.1, fe_backend="vpu")
+    vm.record_dispatch("xla", "secp256k1", 128, 0.3, fe_backend="mxu",
+                       carry_mode="lazy")
+    vm.record_dispatch("pallas", "ed25519", 256, 0.1, fe_backend="vpu",
+                       carry_mode="eager")
     vm.host_fallback.add(1.0, ("no_tpu",))
     vm.speculative.add(3.0, ("hit",))
     vm.window_heights.observe(512.0)
@@ -362,7 +364,8 @@ def _self_check():
         "tendermint_verify_device_fallback_total",
         "tendermint_verify_device_retries_total",
         "tendermint_verify_device_audit_total",
-        # limb-multiplier backend attribution ([verify] fe_backend)
+        # limb-multiplier backend + carry-schedule attribution
+        # ([verify] fe_backend / carry_mode label)
         "tendermint_verify_fe_backend_total",
     )
     verify_text = vm.registry.expose_text()

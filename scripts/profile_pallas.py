@@ -265,7 +265,10 @@ def main(argv=None):
         from tendermint_tpu.libs.metrics import get_verify_metrics
 
         get_verify_metrics().record_dispatch(
-            kind, "ed25519", n, e2e_ms / 1e3, fe_backend=be
+            kind, "ed25519", n, e2e_ms / 1e3, fe_backend=be,
+            # the kernels default to the lazy schedule; mxu16 has no lazy
+            # plan and degrades (fe_common.effective_carry_mode)
+            carry_mode="eager" if be == "mxu16" else "lazy",
         )
     except Exception:
         pass

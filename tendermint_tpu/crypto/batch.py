@@ -34,15 +34,16 @@ from tendermint_tpu.libs.metrics import get_verify_metrics
 
 
 def _record_dispatch(backend: str, algo: str, n: int, t0: float, ok,
-                     first: bool = False, fe_backend: str = "") -> None:
+                     first: bool = False, fe_backend: str = "",
+                     carry_mode: str = "") -> None:
     """One VerifyMetrics record per batch dispatch (size, latency, rejects,
-    and which limb-multiplier backend served the window).  Telemetry must
-    never take down the verify path."""
+    and which limb-multiplier backend / carry schedule served the window).
+    Telemetry must never take down the verify path."""
     try:
         get_verify_metrics().record_dispatch(
             backend, algo, n, time.perf_counter() - t0,
             rejects=n - int(np.count_nonzero(ok)), first=first,
-            fe_backend=fe_backend,
+            fe_backend=fe_backend, carry_mode=carry_mode,
         )
     except Exception:
         pass
@@ -166,6 +167,11 @@ class TPUBatchVerifier:
     def __init__(self, mesh=None, backend: Optional[str] = None,
                  fe_backend: Optional[str] = None):
         self.fe_backend = _resolve_fe_backend(fe_backend)
+        # carry schedule the kernels will trace with — the kernels default
+        # to lazy and degrade mxu16 to eager themselves
+        # (fe_common.effective_carry_mode); mirrored here, without the jax
+        # import, so telemetry labels match what actually ran
+        self.carry_mode = "eager" if self.fe_backend == "mxu16" else "lazy"
         self._mesh = mesh
         self._tpu = None
         if backend is None:
@@ -234,7 +240,8 @@ class TPUBatchVerifier:
         ok = np.asarray(ok, dtype=bool)
         self._warm.add("ed25519")
         _record_dispatch(self.backend, "ed25519", len(pubs), t0, ok,
-                         first=first, fe_backend=self.fe_backend)
+                         first=first, fe_backend=self.fe_backend,
+                         carry_mode=self.carry_mode)
         return ok
 
     def verify_secp256k1(self, items: Sequence[SigItem]) -> np.ndarray:
@@ -268,7 +275,8 @@ class TPUBatchVerifier:
         ok = np.asarray(ok, dtype=bool)
         self._warm.add("secp256k1")
         _record_dispatch(self.backend, "secp256k1", len(items), t0, ok,
-                         first=first, fe_backend=self.fe_backend)
+                         first=first, fe_backend=self.fe_backend,
+                         carry_mode=self.carry_mode)
         return ok
 
 

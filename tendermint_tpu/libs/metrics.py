@@ -384,17 +384,20 @@ class VerifyMetrics:
             label_names=("outcome",),
         )
         # limb-multiplier attribution: which fe backend (ops/fe_common)
-        # served each device window — vpu | mxu | mxu16; host dispatches
-        # carry no fe backend and are not recorded here
+        # served each device window — vpu | mxu | mxu16 — and which carry
+        # schedule it traced with (eager | lazy); host dispatches carry
+        # no fe backend and are not recorded here
         self.fe_dispatch = r.counter(
             "verify_fe_backend_total",
-            "Batch-verify device dispatches by limb-multiplier backend",
-            label_names=("backend", "fe_backend"),
+            "Batch-verify device dispatches by limb-multiplier backend "
+            "and carry schedule",
+            label_names=("backend", "fe_backend", "carry_mode"),
         )
 
     def record_dispatch(self, backend: str, algo: str, n: int,
                         seconds: float, rejects: int = 0,
-                        first: bool = False, fe_backend: str = "") -> None:
+                        first: bool = False, fe_backend: str = "",
+                        carry_mode: str = "") -> None:
         """One batch dispatch: size + latency + outcome in one call so the
         instrumented hot paths stay one-liners."""
         self.batch_size.observe(float(n))
@@ -406,7 +409,7 @@ class VerifyMetrics:
         if rejects:
             self.rejects.add(float(rejects), (backend, algo))
         if fe_backend:
-            self.fe_dispatch.add(1.0, (backend, fe_backend))
+            self.fe_dispatch.add(1.0, (backend, fe_backend, carry_mode))
 
     def record_planner(self, present: int, dispatched: int,
                        compiled: bool = False) -> None:

@@ -80,6 +80,7 @@ class Profiler:
         compiled: bool = False,
         bytes_to_device: int = 0,
         fe_backend: str = "",
+        carry_mode: str = "",
     ) -> None:
         win = getattr(_tls, "window", None)
         entry = {
@@ -87,6 +88,10 @@ class Profiler:
             # limb-multiplier backend that served this dispatch
             # (ops/fe_common: vpu | mxu | mxu16; "" = host / not applicable)
             "fe_backend": str(fe_backend),
+            # carry schedule the dispatch traced with (eager | lazy;
+            # "" = host / not applicable) — the effective mode after
+            # fe_common.effective_carry_mode's mxu16 degrade
+            "carry_mode": str(carry_mode),
             "height_base": win[0] if win else None,
             "heights": heights or (win[1] if win else 0),
             "bucket": list(bucket),
@@ -171,6 +176,7 @@ class Profiler:
                     "dispatches": 0,
                     "kinds": [],
                     "fe_backends": [],
+                    "carry_modes": [],
                     "buckets": [],
                     "lanes_present": 0,
                     "lanes_dispatched": 0,
@@ -188,6 +194,9 @@ class Profiler:
             fb = e.get("fe_backend", "")
             if fb and fb not in row["fe_backends"]:
                 row["fe_backends"].append(fb)
+            cm = e.get("carry_mode", "")
+            if cm and cm not in row["carry_modes"]:
+                row["carry_modes"].append(cm)
             if e["bucket"] and e["bucket"] not in row["buckets"]:
                 row["buckets"].append(e["bucket"])
             row["lanes_present"] += e["lanes_present"]

@@ -75,8 +75,18 @@ int_to_limbs = _xla.int_to_limbs
 
 # Field ops live in ops/fe_common.py now (one copy serves both curves and
 # all fe backends); these module-level names keep the original surface.
-_FE = {b: _fc.make_fe("ed25519", b) for b in _fc.FE_BACKENDS}
-_FE_VPU = _FE["vpu"]
+# Namespaces are built on demand per (backend, carry mode) — the lazy ones
+# run derive_carry_plan's chain certification on first use.
+_FE = {(b, "eager"): _fc.make_fe("ed25519", b) for b in _fc.FE_BACKENDS}
+_FE_VPU = _FE[("vpu", "eager")]
+
+
+def _get_fe(backend: str, carry_mode: str = "eager"):
+    mode = _fc.effective_carry_mode(backend, carry_mode)
+    key = (backend, mode)
+    if key not in _FE:
+        _FE[key] = _fc.make_fe("ed25519", backend, carry_mode=mode)
+    return _FE[key]
 
 _shift_rows_down = _fc.shift_rows_down
 fe_carry1 = _fc.ed_fe_carry1
@@ -92,9 +102,24 @@ fe_inv = _fc.ed_fe_inv
 # ---------------------------------------------------------------------------
 
 
-def pt_add(p, q, d2, ksub, fe=_FE_VPU):
+def pt_add(p, q, d2, ksub, fe=_FE_VPU, kd=None):
     X1, Y1, Z1, T1 = p
     X2, Y2, Z2, T2 = q
+    if fe.carry_mode == "lazy":
+        # One full reduction per point op: the four operand products stay in
+        # the deferred class D (mul_lazy), E/F/G/H carry once (against kd —
+        # the wide zero sized for D), and only the four output muls run the
+        # full mulF schedule.  The inner T1*d2 must be mulF: a class-D
+        # operand would overflow the product columns.
+        A = fe.mul_lazy(fe.sub(Y1, X1, ksub), fe.sub(Y2, X2, ksub))
+        B = fe.mul_lazy(fe.add(Y1, X1), fe.add(Y2, X2))
+        C = fe.mul_lazy(fe.mul(T1, d2), T2)
+        Dv = fe.mul_lazy(fe.add_raw(Z1, Z1), Z2)
+        E = fe.sub(B, A, kd)
+        F = fe.sub(Dv, C, kd)
+        G = fe.add(Dv, C)
+        H = fe.add(B, A)
+        return fe.mul(E, F), fe.mul(G, H), fe.mul(F, G), fe.mul(E, H)
     A = fe.mul(fe.sub(Y1, X1, ksub), fe.sub(Y2, X2, ksub))
     B = fe.mul(fe.add(Y1, X1), fe.add(Y2, X2))
     C = fe.mul(fe.mul(T1, d2), T2)
@@ -106,10 +131,20 @@ def pt_add(p, q, d2, ksub, fe=_FE_VPU):
     return fe.mul(E, F), fe.mul(G, H), fe.mul(F, G), fe.mul(E, H)
 
 
-def pt_madd(p, ypx, ymx, t2d, ksub, fe=_FE_VPU):
+def pt_madd(p, ypx, ymx, t2d, ksub, fe=_FE_VPU, kd=None):
     """Mixed add with a precomputed niels point (y+x, y-x, 2dxy), Z=1.
     Digit 0 maps to (1, 1, 0) and yields p unchanged (scaled) — identity-safe."""
     X1, Y1, Z1, T1 = p
+    if fe.carry_mode == "lazy":
+        A = fe.mul_lazy(fe.sub(Y1, X1, ksub), ymx)
+        B = fe.mul_lazy(fe.add_raw(Y1, X1), ypx)
+        C = fe.mul_lazy(T1, t2d)
+        Dv = fe.add_raw(Z1, Z1)
+        E = fe.sub(B, A, kd)
+        F = fe.sub(Dv, C, kd)
+        G = fe.add(Dv, C)
+        H = fe.add(B, A)
+        return fe.mul(E, F), fe.mul(G, H), fe.mul(F, G), fe.mul(E, H)
     A = fe.mul(fe.sub(Y1, X1, ksub), ymx)
     B = fe.mul(fe.add(Y1, X1), ypx)
     C = fe.mul(T1, t2d)
@@ -121,8 +156,44 @@ def pt_madd(p, ypx, ymx, t2d, ksub, fe=_FE_VPU):
     return fe.mul(E, F), fe.mul(G, H), fe.mul(F, G), fe.mul(E, H)
 
 
-def pt_double(p, ksub, fe=_FE_VPU):
+def pt_add_cached(p, c, ksub, kd, fe):
+    """Lazy-only add against a cached-niels table entry (y+x, y-x, Z, 2dxy·T
+    pre-scaled): the pt_madd shape plus a projective Z2, so the per-window
+    table add carries once instead of the nine times the extended formula
+    spent."""
+    X1, Y1, Z1, T1 = p
+    ypx2, ymx2, Z2, t2d2 = c
+    A = fe.mul_lazy(fe.sub(Y1, X1, ksub), ymx2)
+    B = fe.mul_lazy(fe.add_raw(Y1, X1), ypx2)
+    C = fe.mul_lazy(T1, t2d2)
+    Dv = fe.mul_lazy(fe.add_raw(Z1, Z1), Z2)
+    E = fe.sub(B, A, kd)
+    F = fe.sub(Dv, C, kd)
+    G = fe.add(Dv, C)
+    H = fe.add(B, A)
+    return fe.mul(E, F), fe.mul(G, H), fe.mul(F, G), fe.mul(E, H)
+
+
+def pt_to_cached(p, d2, ksub, fe):
+    """Extended -> cached-niels (y+x, y-x, Z, 2d·T); identity-safe
+    ((0,1,1,0) -> (1,1,1,0))."""
+    X, Y, Z, T = p
+    return fe.add(Y, X), fe.sub(Y, X, ksub), Z, fe.mul(T, d2)
+
+
+def pt_double(p, ksub, fe=_FE_VPU, kd=None):
     X1, Y1, Z1, _ = p
+    if fe.carry_mode == "lazy":
+        A = fe.mul_lazy(X1, X1)
+        B = fe.mul_lazy(Y1, Y1)
+        ZZ = fe.mul_lazy(Z1, Z1)
+        C = fe.add_raw(ZZ, ZZ)
+        H = fe.add(A, B)
+        xy = fe.add(X1, Y1)
+        E = fe.sub(H, fe.mul_lazy(xy, xy), kd)
+        G = fe.sub(A, B, kd)
+        F = fe.add(C, G)
+        return fe.mul(E, F), fe.mul(G, H), fe.mul(F, G), fe.mul(E, H)
     A = fe.sq(X1)
     B = fe.sq(Y1)
     ZZ = fe.sq(Z1)
@@ -161,7 +232,8 @@ _B_NIELS = _build_b_niels()
 
 # All per-limb constants bundled into one (20, 52) kernel input (Pallas
 # kernels cannot capture array constants): columns 0..15 = ypx of [j]B,
-# 16..31 = ymx, 32..47 = t2d, 48 = 2d, 49 = the fe_sub K constant.
+# 16..31 = ymx, 32..47 = t2d, 48 = 2d, 49 = the fe_sub K constant, 50 = KD
+# (the wide zero the lazy carry plan sizes for deferred-class subtraction).
 _CONSTS = np.zeros((NLIMB, 52), dtype=np.uint32)
 for _j in range(16):
     _CONSTS[:, _j] = _B_NIELS[_j, 0]
@@ -169,6 +241,7 @@ for _j in range(16):
     _CONSTS[:, 32 + _j] = _B_NIELS[_j, 2]
 _CONSTS[:, 48] = _D2_LIMBS
 _CONSTS[:, 49] = _K_SUB
+_CONSTS[:, 50] = np.asarray(_fc.derive_carry_plan("ed25519").kd, np.uint32)
 
 
 # ---------------------------------------------------------------------------
@@ -209,7 +282,8 @@ def _canonical_ref(v, s1, s2):
 
 
 def ladder_math(consts, negax, ay, digs_get, digh_get, nwin: int = NWIN,
-                loop=lax.fori_loop, fe_backend: str = "vpu"):
+                loop=lax.fori_loop, fe_backend: str = "vpu",
+                carry_mode: str = "lazy"):
     """The windowed-Straus double-scalar multiply [s]B + [h](-A) — pure jnp,
     shared by the pallas kernel (on ref values) and the CPU parity tests
     (tests/test_pallas_interpret.py).  digs_get/digh_get: t -> (1, B)
@@ -218,13 +292,19 @@ def ladder_math(consts, negax, ay, digs_get, digh_get, nwin: int = NWIN,
     swap `loop` for a plain Python loop so the whole thing evaluates
     eagerly (XLA's CPU compile of these graphs runs minutes — its
     simplifier thrashes on the carry patterns).  fe_backend picks the limb
-    multiplier (fe_common.FE_BACKENDS).  Returns (X, Y, Z, T)."""
-    fe = _FE[fe_backend]
+    multiplier (fe_common.FE_BACKENDS); carry_mode picks eager (one carry
+    ripple per field op) or lazy (one per point op; the default — mxu16
+    degrades to eager).  Returns (X, Y, Z, T) with limbs in the certified
+    carried class of the active mode (congruent mod p across modes)."""
+    mode = _fc.effective_carry_mode(fe_backend, carry_mode)
+    fe = _get_fe(fe_backend, mode)
+    lazy = mode == "lazy"
     B = negax.shape[1]
     zero = jnp.zeros((NLIMB, B), jnp.uint32)
     one = jnp.pad(jnp.ones((1, B), jnp.uint32), ((0, NLIMB - 1), (0, 0)))
     d2 = consts[:, 48:49]
     ksub = consts[:, 49:50]
+    kd = consts[:, 50:51] if lazy else None
 
     ident = (zero, one, one, zero)
     a1 = (negax, ay, one, fe.mul(negax, ay))
@@ -232,8 +312,12 @@ def ladder_math(consts, negax, ay, digs_get, digh_get, nwin: int = NWIN,
     # per-signature table [0..15](-A): evens by doubling, odds by +(-A)
     tbl = [ident, a1]
     for j in range(2, 16):
-        tbl.append(pt_double(tbl[j // 2], ksub, fe) if j % 2 == 0
-                   else pt_add(tbl[j - 1], a1, d2, ksub, fe))
+        tbl.append(pt_double(tbl[j // 2], ksub, fe, kd) if j % 2 == 0
+                   else pt_add(tbl[j - 1], a1, d2, ksub, fe, kd))
+    if lazy:
+        # cached-niels conversion: one mulF + two carries per entry buys a
+        # pt_add_cached per window (353 vs 457 row-slots of carry work)
+        tbl = [pt_to_cached(t, d2, ksub, fe) for t in tbl]
     tbl_x = jnp.stack([t[0] for t in tbl])  # (16, 20, B)
     tbl_y = jnp.stack([t[1] for t in tbl])
     tbl_z = jnp.stack([t[2] for t in tbl])
@@ -248,7 +332,7 @@ def ladder_math(consts, negax, ay, digs_get, digh_get, nwin: int = NWIN,
 
     def body(t, acc):
         for _ in range(4):
-            acc = pt_double(acc, ksub, fe)
+            acc = pt_double(acc, ksub, fe, kd)
         ds = digs_get(t)  # (1, B)
         dh = digh_get(t)
         mk_s = [(ds == j).astype(jnp.uint32) for j in range(16)]
@@ -257,10 +341,11 @@ def ladder_math(consts, negax, ay, digs_get, digh_get, nwin: int = NWIN,
         ypx = sum(consts[:, j : j + 1] * mk_s[j] for j in range(16))
         ymx = sum(consts[:, 16 + j : 17 + j] * mk_s[j] for j in range(16))
         t2d = sum(consts[:, 32 + j : 33 + j] * mk_s[j] for j in range(16))
-        acc = pt_madd(acc, ypx, ymx, t2d, ksub, fe)
+        acc = pt_madd(acc, ypx, ymx, t2d, ksub, fe, kd)
         q = (select16(tbl_x, mk_h), select16(tbl_y, mk_h),
              select16(tbl_z, mk_h), select16(tbl_t, mk_h))
-        acc = pt_add(acc, q, d2, ksub, fe)
+        acc = (pt_add_cached(acc, q, ksub, kd, fe) if lazy
+               else pt_add(acc, q, d2, ksub, fe))
         return acc
 
     return loop(0, nwin, body, ident)
@@ -268,7 +353,7 @@ def ladder_math(consts, negax, ay, digs_get, digh_get, nwin: int = NWIN,
 
 def _ladder_kernel(consts_ref, negax_ref, ay_ref, digs_ref, digh_ref,
                    rlimb_ref, rsign_ref, out_ref, s1, s2,
-                   fe_backend: str = "vpu"):
+                   fe_backend: str = "vpu", carry_mode: str = "lazy"):
     # window count comes from the digit rows: production always passes
     # (NWIN, B), while reduced parity tests drive the identical math with
     # fewer windows (small scalars)
@@ -278,9 +363,12 @@ def _ladder_kernel(consts_ref, negax_ref, ay_ref, digs_ref, digh_ref,
         lambda t: digh_ref[pl.ds(t, 1), :],
         nwin=digs_ref.shape[0],
         fe_backend=fe_backend,
+        carry_mode=carry_mode,
     )
 
-    fe = _FE[fe_backend]
+    # Under lazy, fe.inv/fe.mul run on mulF and keep the epilogue inside the
+    # certified class C (max limb < M), so _canonical_ref's domain holds.
+    fe = _get_fe(fe_backend, carry_mode)
     zinv = fe.inv(Z)
     x = _canonical_ref(fe.mul(X, zinv), s1, s2)
     y = _canonical_ref(fe.mul(Y, zinv), s1, s2)
@@ -290,17 +378,17 @@ def _ladder_kernel(consts_ref, negax_ref, ay_ref, digs_ref, digh_ref,
 
 
 def _ladder_call(negax, ay, digs, digh, rlimb, rsign, *, interpret=False,
-                 lanes=LANES, fe_backend="vpu"):
+                 lanes=LANES, fe_backend="vpu", carry_mode="lazy"):
     """negax/ay/rlimb (20, N), digs/digh (nwin, N) — NWIN=64 in production,
     fewer in the reduced interpret tests — rsign (1, N); N % lanes == 0."""
     n = negax.shape[1]
     nwin = digs.shape[0]
-    cspec = pl.BlockSpec((NLIMB, 52), lambda i: (0, 0), memory_space=pltpu.VMEM)
+    cspec = pl.BlockSpec(_CONSTS.shape, lambda i: (0, 0), memory_space=pltpu.VMEM)
     spec20 = pl.BlockSpec((NLIMB, lanes), lambda i: (0, i), memory_space=pltpu.VMEM)
     spec64 = pl.BlockSpec((nwin, lanes), lambda i: (0, i), memory_space=pltpu.VMEM)
     spec1 = pl.BlockSpec((1, lanes), lambda i: (0, i), memory_space=pltpu.VMEM)
     return pl.pallas_call(
-        partial(_ladder_kernel, fe_backend=fe_backend),
+        partial(_ladder_kernel, fe_backend=fe_backend, carry_mode=carry_mode),
         out_shape=jax.ShapeDtypeStruct((1, n), jnp.uint32),
         grid=(n // lanes,),
         in_specs=[cspec, spec20, spec20, spec64, spec64, spec20, spec1],
@@ -586,7 +674,7 @@ def _prologue_call(msg_words, sig_words, *, interpret=False, lanes=LANES):
 
 
 def _device_verify(negax, ay, sig_words, msg_words, interpret=False,
-                   lanes=LANES, fe_backend="vpu"):
+                   lanes=LANES, fe_backend="vpu", carry_mode="lazy"):
     """negax/ay (N, 20) uint32; sig_words (N, 16) uint32 LE; msg_words
     (N, nblocks*32) uint32 BE padded SHA-512 input. Returns (N,) bool."""
     digs, digh, rlimb, rsign = _prologue_call(
@@ -594,7 +682,7 @@ def _device_verify(negax, ay, sig_words, msg_words, interpret=False,
     )
     ok = _ladder_call(
         negax.T, ay.T, digs, digh, rlimb, rsign, interpret=interpret,
-        lanes=lanes, fe_backend=fe_backend,
+        lanes=lanes, fe_backend=fe_backend, carry_mode=carry_mode,
     )
     return ok[0].astype(bool)
 
@@ -603,13 +691,13 @@ def _device_verify(negax, ay, sig_words, msg_words, interpret=False,
 # function is called eagerly instead: tracing the interpreted kernels into one
 # jit graph explodes into thousands of scalar XLA ops (a 6-minute CPU compile).
 _device_verify_jit = partial(
-    jax.jit, static_argnames=("interpret", "lanes", "fe_backend")
+    jax.jit, static_argnames=("interpret", "lanes", "fe_backend", "carry_mode")
 )(_device_verify)
 
 
-@partial(jax.jit, static_argnames=("lanes", "fe_backend"))
+@partial(jax.jit, static_argnames=("lanes", "fe_backend", "carry_mode"))
 def _device_verify_packed(negax, ay, pub_words, sig_words, tmpl, vidx, vwords,
-                          lanes=LANES, fe_backend="vpu"):
+                          lanes=LANES, fe_backend="vpu", carry_mode="lazy"):
     """Transfer-minimizing verify: the padded SHA-512 input is ASSEMBLED ON
     DEVICE instead of shipped over the wire.
 
@@ -639,7 +727,7 @@ def _device_verify_packed(negax, ay, pub_words, sig_words, tmpl, vidx, vwords,
     mw = mw.at[vidx, :].set(vwords.T)
     digs, digh, rlimb, rsign = _prologue_call(mw, sig_words.T, lanes=lanes)
     ok = _ladder_call(negax.T, ay.T, digs, digh, rlimb, rsign, lanes=lanes,
-                      fe_backend=fe_backend)
+                      fe_backend=fe_backend, carry_mode=carry_mode)
     return ok[0].astype(bool)
 
 
@@ -724,13 +812,17 @@ def _bucket(n: int, lanes: int = LANES) -> int:
 
 def verify_batch(pubs: np.ndarray, msgs: Sequence[bytes], sigs: np.ndarray,
                  interpret: bool = False, device=None,
-                 fe_backend: str = "vpu") -> np.ndarray:
+                 fe_backend: str = "vpu",
+                 carry_mode: str = "lazy") -> np.ndarray:
     """Go-exact batched verify on the Pallas path. Same contract as
     ops.ed25519_verify.verify_batch. `device` pins the dispatch to a specific
     jax device (used by tests that run on the real chip while the default
     backend is the virtual CPU mesh). `fe_backend` selects the limb
-    multiplier (fe_common.FE_BACKENDS); every backend is bit-exact."""
+    multiplier (fe_common.FE_BACKENDS); every backend is bit-exact.
+    `carry_mode` picks the eager or deferred (lazy) carry schedule — both
+    bit-exact at the canonical boundary; mxu16 silently runs eager."""
     fe_backend = _fc.normalize_backend(fe_backend)
+    carry_mode = _fc.normalize_carry_mode(carry_mode)
     pubs = np.ascontiguousarray(pubs, dtype=np.uint8)
     sigs = np.ascontiguousarray(sigs, dtype=np.uint8)
     n = pubs.shape[0]
@@ -747,7 +839,7 @@ def verify_batch(pubs: np.ndarray, msgs: Sequence[bytes], sigs: np.ndarray,
         out[idx] = _verify_uniform(
             pubs[idx], [msgs[i] for i in idx], sigs[idx],
             neg_ax[idx], ay[idx], valid[idx], int(ln), interpret, device,
-            fe_backend,
+            fe_backend, carry_mode,
         )
     return out
 
@@ -803,7 +895,7 @@ def pack_variable_words(pubs, msgs, sigs, ln: int, b: int):
 
 
 def _verify_uniform(pubs, msgs, sigs, neg_ax, ay, valid, ln, interpret,
-                    device=None, fe_backend="vpu"):
+                    device=None, fe_backend="vpu", carry_mode="lazy"):
     n = pubs.shape[0]
     # interpret mode (CPU tests) has no tile-alignment constraint: shrink the
     # lane count so the eager interpreter does 16x less padded work.
@@ -830,7 +922,7 @@ def _verify_uniform(pubs, msgs, sigs, neg_ax, ay, valid, ln, interpret,
                 negax_d, ay_d, pubw_d,
                 put(_pad_rows(sig_words, b)),
                 put(tmpl), put(vrows), put(vwords),
-                lanes=lanes, fe_backend=fe_backend,
+                lanes=lanes, fe_backend=fe_backend, carry_mode=carry_mode,
             )
         )[:n]
         return ok & valid
@@ -857,6 +949,7 @@ def _verify_uniform(pubs, msgs, sigs, neg_ax, ay, valid, ln, interpret,
             interpret=interpret,
             lanes=lanes,
             fe_backend=fe_backend,
+            carry_mode=carry_mode,
         )
     )[:n]
     return ok & valid

@@ -119,6 +119,18 @@ def fe_sub(a, b):
 # jit cache is keyed on it, so each backend traces its own kernel.
 _FE_BACKEND = "vpu"
 
+# Carry schedule for the ladder's point ops: "eager" is the full per-op
+# ripple below; "lazy" defers carries per fe_common.derive_carry_plan (one
+# reduction per point op). Swapped the same trace-time way as _FE_BACKEND
+# (fe_common.trace_with_modes); module-level fe_mul/fe_add/fe_sub are always
+# the eager ops regardless.
+_CARRY_MODE = "eager"
+
+_PLAN = _fc.derive_carry_plan("ed25519")
+# wide zero dominating the lazy class-D operands (plan-derived analog of
+# _K_SUB, which dominates carried eager values only)
+_KD_SUB = np.asarray(_PLAN.kd, dtype=np.uint32)
+
 
 def fe_mul(a, b):
     """Schoolbook product via 20 shifted multiply-accumulates, then reduce.
@@ -153,6 +165,52 @@ def fe_mul(a, b):
 
 def fe_sq(a):
     return fe_mul(a, a)
+
+
+# --- deferred-carry (lazy) ops: batch-leading twins of the Pallas row ops,
+# used by the ladder's point ops when _CARRY_MODE == "lazy".  Operand-class
+# bounds are certified at import by fe_common.derive_carry_plan; lazy-mode
+# operands exceed the int8 plane bound, so mxu uses uint8 planes (split=8).
+
+
+def _mul_cols(a, b, out_cols):
+    if _FE_BACKEND != "vpu":
+        return _fc.mul_columns_batch(a, b, out_cols, split=8)
+    shape = jnp.broadcast_shapes(a.shape[:-1], b.shape[:-1])
+    prod = jnp.zeros(shape + (out_cols,), dtype=jnp.uint32)
+    for i in range(NLIMB):
+        prod = prod.at[..., i : i + NLIMB].add(a[..., i : i + 1] * b)
+    return prod
+
+
+def fe_mul_f(a, b):
+    """Full lazy multiply: fused fold, plan.mulf_wide wide rounds, fixups —
+    output lands in the certified class C."""
+    lo = _fc.ed_fold_fused_batch(_mul_cols(a, b, 2 * NLIMB))
+    for _ in range(_PLAN.mulf_wide):
+        lo = _fc.wide_carry_batch(lo, _fc.ED_WRAP)
+    return _fc.fix_batch(lo, _PLAN.mulf_fix)
+
+
+def fe_mul_l(a, b):
+    """Lazy multiply with a single wide round: output stays in class D."""
+    lo = _fc.ed_fold_fused_batch(_mul_cols(a, b, 2 * NLIMB))
+    lo = _fc.wide_carry_batch(lo, _fc.ED_WRAP)
+    return _fc.fix_batch(lo, _PLAN.mull_fix)
+
+
+def fe_norm1(raw):
+    """One wide round + fixups: raw limb sum -> class C."""
+    return _fc.fix_batch(_fc.wide_carry_batch(raw, _fc.ED_WRAP), _PLAN.norm_fix)
+
+
+def fe_add_l(a, b):
+    return fe_norm1(a + b)
+
+
+def fe_sub_l(a, b):
+    # always against the class-D wide zero: dominates class-C operands too
+    return fe_norm1(a + _KD_SUB - b)
 
 
 def fe_inv(z):
@@ -203,6 +261,20 @@ def fe_canonical(x):
 def pt_add(p, q, d2):
     X1, Y1, Z1, T1 = p
     X2, Y2, Z2, T2 = q
+    if _CARRY_MODE == "lazy":
+        # one full reduction per point op: operand products ride as class D,
+        # E/F/G/H carry once, only the four output muls run the full mulF
+        # schedule.  The inner T1*d2 must be mulF — a class-D operand would
+        # overflow the product columns.
+        A = fe_mul_l(fe_sub_l(Y1, X1), fe_sub_l(Y2, X2))
+        B = fe_mul_l(fe_add_l(Y1, X1), fe_add_l(Y2, X2))
+        C = fe_mul_l(fe_mul_f(T1, d2), T2)
+        Dv = fe_mul_l(Z1 + Z1, Z2)
+        E = fe_sub_l(B, A)
+        F = fe_sub_l(Dv, C)
+        G = fe_add_l(Dv, C)
+        H = fe_add_l(B, A)
+        return fe_mul_f(E, F), fe_mul_f(G, H), fe_mul_f(F, G), fe_mul_f(E, H)
     A = fe_mul(fe_sub(Y1, X1), fe_sub(Y2, X2))
     B = fe_mul(fe_add(Y1, X1), fe_add(Y2, X2))
     C = fe_mul(fe_mul(T1, d2), T2)
@@ -216,6 +288,17 @@ def pt_add(p, q, d2):
 
 def pt_double(p):
     X1, Y1, Z1, _ = p
+    if _CARRY_MODE == "lazy":
+        A = fe_mul_l(X1, X1)
+        B = fe_mul_l(Y1, Y1)
+        ZZ = fe_mul_l(Z1, Z1)
+        C = ZZ + ZZ
+        H = fe_add_l(A, B)
+        xy = fe_add_l(X1, Y1)
+        E = fe_sub_l(H, fe_mul_l(xy, xy))
+        G = fe_sub_l(A, B)
+        F = fe_add_l(C, G)
+        return fe_mul_f(E, F), fe_mul_f(G, H), fe_mul_f(F, G), fe_mul_f(E, H)
     A = fe_sq(X1)
     B = fe_sq(Y1)
     ZZ = fe_sq(Z1)
@@ -261,7 +344,10 @@ def _verify_kernel(neg_ax, ay, s_words, h_words, r_limbs, r_sign):
     zero = jnp.zeros(batch + (NLIMB,), jnp.uint32)
     d2 = jnp.asarray(_D2_LIMBS)
 
-    neg_a = (neg_ax, ay, one, fe_mul(neg_ax, ay))
+    # the T coordinate must land in the lazy class C when the ladder defers
+    # carries (eager-carried limbs can exceed it — limb 0 tops at ~11231)
+    t_mul = fe_mul_f if _CARRY_MODE == "lazy" else fe_mul
+    neg_a = (neg_ax, ay, one, t_mul(neg_ax, ay))
     b_pt = (
         jnp.broadcast_to(jnp.asarray(_BX_LIMBS), batch + (NLIMB,)),
         jnp.broadcast_to(jnp.asarray(_BY_LIMBS), batch + (NLIMB,)),
@@ -292,16 +378,18 @@ def _verify_kernel(neg_ax, ay, s_words, h_words, r_limbs, r_sign):
 _kernel_cache = {}
 
 
-def _compiled_kernel(batch: int, mesh=None, fe_backend: str = "vpu"):
+def _compiled_kernel(batch: int, mesh=None, fe_backend: str = "vpu",
+                     carry_mode: str = "eager"):
     # Mesh hashes by devices+axis_names — safe cache key (id() could be reused
     # by a new Mesh after gc and serve a stale sharding)
+    carry_mode = _fc.effective_carry_mode(fe_backend, carry_mode)
     if fe_backend not in ("vpu", "mxu"):
         fe_backend = "mxu" if fe_backend == "mxu16" else "vpu"
-    key = (batch, mesh, fe_backend)
+    key = (batch, mesh, fe_backend, carry_mode)
     fn = _kernel_cache.get(key)
     if fn is None:
-        kernel = _fc.trace_with_backend(
-            sys.modules[__name__], _verify_kernel, fe_backend
+        kernel = _fc.trace_with_modes(
+            sys.modules[__name__], _verify_kernel, fe_backend, carry_mode
         )
         if mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as PS
@@ -419,6 +507,7 @@ def verify_batch(
     sigs: np.ndarray,
     mesh=None,
     fe_backend: str = "vpu",
+    carry_mode: str = "lazy",
 ) -> np.ndarray:
     """Batched Go-exact ed25519 verify.
 
@@ -426,9 +515,12 @@ def verify_batch(
     Returns (N,) bool.  One device dispatch per call (padded to a size bucket
     to bound recompiles).  fe_backend picks the limb multiplier ("vpu" |
     "mxu"; "mxu16" degrades to "mxu" here — the 16-limb repack is row-layout
-    only); every backend is bit-exact.
+    only); carry_mode "lazy" (default) defers limb carries between the
+    ladder's point ops, "eager" keeps the full per-op ripple; every
+    combination is bit-exact.
     """
     fe_backend = _fc.normalize_backend(fe_backend)
+    carry_mode = _fc.normalize_carry_mode(carry_mode)
     n = len(pubs)
     if n == 0:
         return np.zeros((0,), dtype=bool)
@@ -455,5 +547,5 @@ def verify_batch(
 
         data = NamedSharding(mesh, PS(mesh.axis_names[0]))
         args = [jax.device_put(a, data) for a in args]
-    ok = np.asarray(_compiled_kernel(b, mesh, fe_backend)(*args))[:n]
+    ok = np.asarray(_compiled_kernel(b, mesh, fe_backend, carry_mode)(*args))[:n]
     return ok & valid

@@ -35,11 +35,12 @@ ed25519 limbs <= M_ED = 13000; secp256k1 is non-uniform (the two-term fold
 
 from __future__ import annotations
 
-from functools import partial
+from functools import lru_cache, partial
 from types import SimpleNamespace
 from typing import List, Sequence, Tuple
 
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 NLIMB = 20
@@ -63,7 +64,24 @@ SECP_FOLD256_16 = ((0, 977, 0), (2, 1, 0))  # 2^32 = 2^(16*2)
 
 ED_M = 13000  # uniform carried-limb bound (closed set, asserted in tests)
 
+# Carry wraps as (row, multiplier, shift) placements — the single source the
+# jnp ops and their bound mirrors share.
+ED_WRAP = ((0, ED_FOLD, 0),)
+SECP_WRAP = ((0, SECP_FOLD_SMALL, 0), (2, 1, SECP_FOLD_SHIFT))
+
+# Eager carry-round counts.  These are DERIVED, not pinned: derive_eager_rounds
+# (bottom of this module) reruns the bound propagators at import time and
+# asserts each constant is the minimal round count under which the op's output
+# on closed-set inputs stays inside the closed set — the docstring
+# ripple-carry proofs, executed.
+ED_MUL_TAIL_ROUNDS = 2
+ED_ADD_ROUNDS = 1
+SECP_MUL_TAIL_ROUNDS = 3  # was 5: the propagators prove 2 rounds were wasted
+SECP_ADD_ROUNDS = 3
+SECP_MUL_SMALL_ROUNDS = 3  # was 4, same derivation
+
 FE_BACKENDS = ("vpu", "mxu", "mxu16")
+CARRY_MODES = ("eager", "lazy")
 
 _R16 = 16  # radix-2^16 rows covering a value < 2^256
 MASK16 = (1 << 16) - 1
@@ -168,6 +186,25 @@ def trace_with_backend(mod, kernel, fe_backend):
     return traced
 
 
+def trace_with_modes(mod, kernel, fe_backend, carry_mode):
+    """Like trace_with_backend, but also swaps mod._CARRY_MODE — the XLA
+    verify modules branch on both globals while building the graph.  Always
+    wraps (even for vpu/lazy defaults) so the restore is unconditional."""
+
+    def traced(*args):
+        prev_be = mod._FE_BACKEND
+        prev_cm = mod._CARRY_MODE
+        mod._FE_BACKEND = fe_backend
+        mod._CARRY_MODE = carry_mode
+        try:
+            return kernel(*args)
+        finally:
+            mod._FE_BACKEND = prev_be
+            mod._CARRY_MODE = prev_cm
+
+    return traced
+
+
 def mul_columns_batch(a, b, out_cols, backend="mxu", split=7):
     """Batch-leading variant for the XLA kernels: (..., NLIMB) operands ->
     (..., out_cols) columns.  Only the MXU mapping lives here — the XLA
@@ -216,13 +253,19 @@ def ed_fe_carry1(x):
 
 
 def ed_fe_add(a, b):
-    return ed_fe_carry1(a + b)
+    x = a + b
+    for _ in range(ED_ADD_ROUNDS):
+        x = ed_fe_carry1(x)
+    return x
 
 
 def ed_fe_sub(a, b, ksub):
     """ksub: (NLIMB, 1) multiple-of-p constant keeping the difference
     positive (a kernel input — Pallas kernels cannot capture array consts)."""
-    return ed_fe_carry1(a + ksub - b)
+    x = a + ksub - b
+    for _ in range(ED_ADD_ROUNDS):
+        x = ed_fe_carry1(x)
+    return x
 
 
 def ed_fe_mul(a, b, backend="vpu"):
@@ -234,17 +277,21 @@ def ed_fe_mul(a, b, backend="vpu"):
     c = prod >> BITS
     prod = (prod & MASK) + shift_rows_down(c)  # carry within 40 limbs
     lo = prod[:NLIMB, :] + prod[NLIMB:, :] * ED_FOLD
-    return ed_fe_carry1(ed_fe_carry1(lo))
+    for _ in range(ED_MUL_TAIL_ROUNDS):
+        lo = ed_fe_carry1(lo)
+    return lo
 
 
 def ed_fe_sq(a, backend="vpu"):
     return ed_fe_mul(a, a, backend)
 
 
-def ed_fe_inv(z, backend="vpu"):
-    """z^(p-2) via the standard curve25519 addition chain: 254 sq + 11 mul."""
-    sq = partial(ed_fe_sq, backend=backend)
-    mul = partial(ed_fe_mul, backend=backend)
+def ed_fe_inv(z, backend="vpu", mul=None, sq=None):
+    """z^(p-2) via the standard curve25519 addition chain: 254 sq + 11 mul.
+    mul/sq overrides let the lazy namespaces run the chain on their fully
+    reduced mulF (output class C stays closed under the chain)."""
+    sq = sq if sq is not None else partial(ed_fe_sq, backend=backend)
+    mul = mul if mul is not None else partial(ed_fe_mul, backend=backend)
 
     def sqn(x, n):
         return lax.fori_loop(0, n, lambda _, v: sq(v), x)
@@ -288,13 +335,13 @@ def secp_fe_carry(x, rounds=3):
 
 
 def secp_fe_add(a, b):
-    # 3 rounds: the two-term fold can leave limbs ~3*MASK after two
-    return secp_fe_carry(a + b, rounds=3)
+    # SECP_ADD_ROUNDS = 3: the two-term fold can leave limbs ~3*MASK after two
+    return secp_fe_carry(a + b, rounds=SECP_ADD_ROUNDS)
 
 
 def secp_fe_sub(a, b, ksub):
     """ksub (NLIMB, 1): multiple-of-p constant with every limb >= 2*MASK."""
-    return secp_fe_carry(a + ksub - b, rounds=3)
+    return secp_fe_carry(a + ksub - b, rounds=SECP_ADD_ROUNDS)
 
 
 def secp_fe_mul(a, b, backend="vpu"):
@@ -326,7 +373,7 @@ def secp_fe_mul(a, b, backend="vpu"):
         t = tmp[NLIMB + t_idx : NLIMB + t_idx + 1, :]
         lo = lo + _pad_row(t * SECP_FOLD_SMALL, t_idx, NLIMB)
         lo = lo + _pad_row(t << SECP_FOLD_SHIFT, t_idx + 2, NLIMB)
-    return secp_fe_carry(lo, rounds=5)
+    return secp_fe_carry(lo, rounds=SECP_MUL_TAIL_ROUNDS)
 
 
 def secp_fe_sq(a, backend="vpu"):
@@ -334,13 +381,13 @@ def secp_fe_sq(a, backend="vpu"):
 
 
 def secp_fe_mul_small(a, k: int):
-    return secp_fe_carry(a * jnp.uint32(k), rounds=4)
+    return secp_fe_carry(a * jnp.uint32(k), rounds=SECP_MUL_SMALL_ROUNDS)
 
 
-def secp_fe_inv(z, backend="vpu"):
+def secp_fe_inv(z, backend="vpu", mul=None):
     """z^(p-2), plain MSB-first square-and-multiply (tests only — the secp
     ladder kernel eliminated inversion; see secp256k1_pallas)."""
-    mul = partial(secp_fe_mul, backend=backend)
+    mul = mul if mul is not None else partial(secp_fe_mul, backend=backend)
     e = SECP_P - 2
     acc = z
     for bit in bin(e)[3:]:  # skip the leading 1
@@ -470,32 +517,293 @@ def _mul16_rows(a, b, fold256_13, fold256_16, carry13_1, tail_rounds):
 
 
 # ---------------------------------------------------------------------------
+# Lazy (deferred-carry) ops — ISSUE 11.  The eager pipeline normalizes after
+# every field op (a full parallel carry ripple per add/sub and 2-5 rounds per
+# mul tail); on the closed set that carry work is ~40% of the op mix and all
+# VPU.  The lazy representation defers it:
+#
+#   * mulL ("lazy mul")   fused fold + ONE wide round + a row-0 fixup.  The
+#     output class D has limbs up to ~3e5 — fine for uint32 adds, never fed
+#     back into a multiply.
+#   * mulF ("final mul")  fused fold + `plan.mulf_wide` wide rounds + fixups.
+#     Output class C (limbs <= ~8.8k ed / ~8.2k secp) — the class every
+#     point-op output lands in, certified <= the eager closed set so the
+#     eager epilogues (inv, canonical encode) accept it unchanged.
+#   * add1/sub1 (norm1)   raw limb add (+ a wide-zero constant for sub), ONE
+#     wide round + fixups — replaces the 1-3 round eager add/sub.
+#   * add_raw             no carry at all; the bound chain proves which
+#     consumers tolerate the doubled limbs.
+#
+# The "fused fold" folds product columns 20..39/40 directly during the fold
+# (each high column split into 13-bit pieces so no pre-carry rounds are
+# needed); the "wide round" is a parallel carry round whose wrap term
+# re-enters in decomposed (lo, hi) halves, so an arbitrarily large top carry
+# cannot rebuild a huge row 0 (the single-term eager wrap diverges on
+# unreduced inputs).  Every bound is certified by derive_carry_plan(), which
+# iterates the full kernel chain set to a fixed point with the mirrors below
+# — there are no hand-stated numbers in this section.
+# ---------------------------------------------------------------------------
+
+
+def _pad_block(x, row, nrows):
+    """Place a multi-row block at `row` within an nrows stack (row layout)."""
+    return jnp.pad(x, ((row, nrows - row - x.shape[0]), (0, 0)))
+
+
+def wide_carry_rows(x, wrap):
+    """One parallel carry round with the wrap applied in decomposed (lo, hi)
+    halves: top carry c splits as (c & MASK) at `row` and (c >> 13) at
+    `row + 1`, exact because 2^13·(mult<<sh)·2^(13·row) = (mult<<sh)·2^(13·(row+1))."""
+    c = x >> BITS
+    out = (x & MASK) + shift_rows_down(c)
+    top = c[NLIMB - 1 :, :]
+    for row, mult, sh in wrap:
+        out = out + _pad_row(((top & MASK) * mult) << sh, row, NLIMB)
+        out = out + _pad_row(((top >> BITS) * mult) << sh, row + 1, NLIMB)
+    return out
+
+
+def fix_rows(x, rows):
+    """Sequential single-row carries r -> r+1 (each touches two rows only —
+    far cheaper than a full round; the plan says which rows need it)."""
+    for r in rows:
+        c = x[r : r + 1, :] >> BITS
+        x = x - _pad_row(c << BITS, r, NLIMB) + _pad_row(c, r + 1, NLIMB)
+    return x
+
+
+def carry_drop_top_rows(x):
+    """One parallel carry round over an (nrows, B) stack; the carry out of
+    the last row is dropped — sound only where its bound is 0, which the
+    plan mirror asserts (_b_carry_drop_top)."""
+    c = x >> BITS
+    return (x & MASK) + shift_rows_down(c)
+
+
+def ed_fold_fused_rows(cols):
+    """(40, B) raw product columns -> (20, B): rows 20..39 fold as
+    2^(260+13k) = 608·2^13k with each high column split into (lo, hi) 13-bit
+    pieces, so no pre-carry rounds are needed.  The hi piece of row 39 would
+    land on row 40 — dropped; the plan mirror asserts its bound is 0."""
+    hi = cols[NLIMB:, :]
+    lo = cols[:NLIMB, :] + (hi & MASK) * ED_FOLD
+    return lo + shift_rows_down((hi >> BITS) * ED_FOLD)
+
+
+def ed_fe_mul_lazy(a, b, wide, fix=(0,), backend="vpu"):
+    """Deferred-carry ed25519 multiply: fused fold + `wide` wide rounds +
+    row fixups.  wide/fix come from derive_carry_plan — mulf_wide for the
+    fully reduced class C, mull_wide (1) for the lazy class D.  Lazy-mode
+    operands can exceed the int8 plane bound, so mxu uses uint8 (split=8) —
+    columns are identical integers either way."""
+    cols = mul_columns_rows(a, b, 2 * NLIMB, backend, split=8)
+    lo = ed_fold_fused_rows(cols)
+    for _ in range(wide):
+        lo = wide_carry_rows(lo, ED_WRAP)
+    return fix_rows(lo, fix)
+
+
+def ed_fe_norm1(raw, fix=(0,)):
+    """One wide round + fixups over a raw limb sum — the lazy add1/sub1."""
+    return fix_rows(wide_carry_rows(raw, ED_WRAP), fix)
+
+
+def secp_fold_fused_rows(cols):
+    """(41, B) raw product columns -> (24, B) temp: rows 20..40 fold as
+    2^(260+13k) = (2^36 + 15632)·2^13k with each high column decomposed
+    a + b·2^13 + c·2^26 (no pre-carry).  The c-piece of row 40 would land
+    on temp row 24 — dropped; the plan mirror asserts its bound is 0."""
+    hi = cols[NLIMB:, :]  # (21, B)
+    a = hi & MASK
+    b2 = (hi >> BITS) & MASK
+    c3 = hi >> (2 * BITS)
+    tmp = jnp.pad(cols[:NLIMB, :], ((0, 4), (0, 0)))
+    tmp = tmp + jnp.pad(a * SECP_FOLD_SMALL, ((0, 3), (0, 0)))
+    tmp = tmp + jnp.pad(b2 * SECP_FOLD_SMALL, ((1, 2), (0, 0)))
+    tmp = tmp + jnp.pad(c3 * SECP_FOLD_SMALL + (a << SECP_FOLD_SHIFT),
+                        ((2, 1), (0, 0)))
+    tmp = tmp + jnp.pad(b2 << SECP_FOLD_SHIFT, ((3, 0), (0, 0)))
+    tmp = tmp + jnp.pad((c3 << SECP_FOLD_SHIFT)[:NLIMB, :], ((4, 0), (0, 0)))
+    return tmp
+
+
+def secp_fold2_rows(tmp):
+    """(24, B) temp -> (20, B): the 4 spill rows fold scalar-wise, each
+    decomposed (lo, hi) so the result needs no extra pre-carry."""
+    lo = tmp[:NLIMB, :]
+    for t in range(4):
+        h = tmp[NLIMB + t : NLIMB + t + 1, :]
+        a = h & MASK
+        b2 = h >> BITS
+        lo = lo + _pad_row(a * SECP_FOLD_SMALL, t, NLIMB)
+        lo = lo + _pad_row(b2 * SECP_FOLD_SMALL, t + 1, NLIMB)
+        lo = lo + _pad_row(a << SECP_FOLD_SHIFT, t + 2, NLIMB)
+        lo = lo + _pad_row(b2 << SECP_FOLD_SHIFT, t + 3, NLIMB)
+    return lo
+
+
+def secp_fe_mul_lazy(a, b, wide, fix=(0, 1, 2, 3), backend="vpu", mid=1):
+    """Deferred-carry secp256k1 multiply: two-level fused fold with `mid`
+    dropped-top rounds over the 24-row temp between the levels."""
+    cols = mul_columns_rows(a, b, 2 * NLIMB + 1, backend, split=8)
+    tmp = secp_fold_fused_rows(cols)
+    for _ in range(mid):
+        tmp = carry_drop_top_rows(tmp)
+    lo = secp_fold2_rows(tmp)
+    for _ in range(wide):
+        lo = wide_carry_rows(lo, SECP_WRAP)
+    return fix_rows(lo, fix)
+
+
+def secp_fe_norm1(raw, wide=1, fix=(0, 1, 2, 3)):
+    lo = raw
+    for _ in range(wide):
+        lo = wide_carry_rows(lo, SECP_WRAP)
+    return fix_rows(lo, fix)
+
+
+# --- batch-leading twins for the XLA kernels (..., NLIMB) ------------------
+
+
+def wide_carry_batch(x, wrap):
+    c = x >> BITS
+    out = (x & MASK).at[..., 1:].add(c[..., :-1])
+    top = c[..., -1]
+    for row, mult, sh in wrap:
+        out = out.at[..., row].add(((top & MASK) * mult) << sh)
+        out = out.at[..., row + 1].add(((top >> BITS) * mult) << sh)
+    return out
+
+
+def fix_batch(x, rows):
+    for r in rows:
+        c = x[..., r] >> BITS
+        x = x.at[..., r].set(x[..., r] & MASK).at[..., r + 1].add(c)
+    return x
+
+
+def carry_drop_top_batch(x):
+    c = x >> BITS
+    return (x & MASK).at[..., 1:].add(c[..., :-1])
+
+
+def ed_fold_fused_batch(cols):
+    """(..., 40) columns -> (..., 20); see ed_fold_fused_rows."""
+    hi = cols[..., NLIMB:]
+    lo = cols[..., :NLIMB] + (hi & MASK) * ED_FOLD
+    return lo.at[..., 1:].add(((hi >> BITS) * ED_FOLD)[..., :-1])
+
+
+def secp_fold_fused_batch(cols):
+    """(..., 41) columns -> (..., 24); see secp_fold_fused_rows."""
+    hi = cols[..., NLIMB:]  # (..., 21)
+    a = hi & MASK
+    b2 = (hi >> BITS) & MASK
+    c3 = hi >> (2 * BITS)
+    tmp = jnp.zeros(cols.shape[:-1] + (NLIMB + 4,), jnp.uint32)
+    tmp = tmp.at[..., :NLIMB].set(cols[..., :NLIMB])
+    tmp = tmp.at[..., 0 : NLIMB + 1].add(a * SECP_FOLD_SMALL)
+    tmp = tmp.at[..., 1 : NLIMB + 2].add(b2 * SECP_FOLD_SMALL)
+    tmp = tmp.at[..., 2 : NLIMB + 3].add(
+        c3 * SECP_FOLD_SMALL + (a << SECP_FOLD_SHIFT))
+    tmp = tmp.at[..., 3 : NLIMB + 4].add(b2 << SECP_FOLD_SHIFT)
+    tmp = tmp.at[..., 4 : NLIMB + 4].add((c3 << SECP_FOLD_SHIFT)[..., :NLIMB])
+    return tmp
+
+
+def secp_fold2_batch(tmp):
+    lo = tmp[..., :NLIMB]
+    for t in range(4):
+        h = tmp[..., NLIMB + t]
+        a = h & MASK
+        b2 = h >> BITS
+        lo = (
+            lo.at[..., t].add(a * SECP_FOLD_SMALL)
+            .at[..., t + 1].add(b2 * SECP_FOLD_SMALL)
+            .at[..., t + 2].add(a << SECP_FOLD_SHIFT)
+            .at[..., t + 3].add(b2 << SECP_FOLD_SHIFT)
+        )
+    return lo
+
+
+# ---------------------------------------------------------------------------
 # Backend namespaces — what the Pallas kernels thread through their point ops
 # ---------------------------------------------------------------------------
 
 
-def make_fe(curve: str, backend: str = "vpu") -> SimpleNamespace:
+def make_fe(curve: str, backend: str = "vpu",
+            carry_mode: str = "eager") -> SimpleNamespace:
     """Uniform op namespace: mul/sq/add/sub/inv/carry (+ mul_small on secp).
     add/sub/carry are backend-independent (pure VPU); mul/sq/inv honor the
-    backend."""
+    backend.
+
+    carry_mode="lazy" swaps in the deferred-carry ops: mul becomes mulF
+    (output in the certified fully-reduced class C), mul_lazy/add_raw expose
+    the cheaper unreduced forms, add/sub carry once instead of fully, and
+    sub against class-D operands must use fe.kd (the wide multiple of p
+    sized for D) instead of the eager ksub.  The mxu16 backend keeps its own
+    fused 16-limb pipeline and degrades to eager (effective_carry_mode)."""
     if backend not in FE_BACKENDS:
         raise ValueError(f"fe backend must be one of {FE_BACKENDS}, got {backend!r}")
+    if carry_mode not in CARRY_MODES:
+        raise ValueError(f"carry mode must be one of {CARRY_MODES}, got {carry_mode!r}")
+    lazy = effective_carry_mode(backend, carry_mode) == "lazy"
     if curve == "ed25519":
+        if not lazy:
+            return SimpleNamespace(
+                curve=curve, backend=backend, carry_mode="eager", plan=None,
+                kd=None,
+                mul=partial(ed_fe_mul, backend=backend),
+                sq=partial(ed_fe_sq, backend=backend),
+                inv=partial(ed_fe_inv, backend=backend),
+                add=ed_fe_add, sub=ed_fe_sub, carry=ed_fe_carry1,
+            )
+        plan = derive_carry_plan(curve, backend)
+        mul = partial(ed_fe_mul_lazy, wide=plan.mulf_wide, fix=plan.mulf_fix,
+                      backend=backend)
         return SimpleNamespace(
-            curve=curve, backend=backend,
-            mul=partial(ed_fe_mul, backend=backend),
-            sq=partial(ed_fe_sq, backend=backend),
-            inv=partial(ed_fe_inv, backend=backend),
-            add=ed_fe_add, sub=ed_fe_sub, carry=ed_fe_carry1,
+            curve=curve, backend=backend, carry_mode="lazy", plan=plan,
+            kd=np.asarray(plan.kd, np.uint32),
+            mul=mul,
+            mul_lazy=partial(ed_fe_mul_lazy, wide=plan.mull_wide,
+                             fix=plan.mull_fix, backend=backend),
+            sq=lambda a: mul(a, a),
+            inv=partial(ed_fe_inv, mul=mul, sq=lambda a: mul(a, a)),
+            add=lambda a, b: ed_fe_norm1(a + b, fix=plan.norm_fix),
+            sub=lambda a, b, k: ed_fe_norm1(a + k - b, fix=plan.norm_fix),
+            add_raw=lambda a, b: a + b,
+            carry=ed_fe_carry1,
         )
     if curve == "secp256k1":
+        if not lazy:
+            return SimpleNamespace(
+                curve=curve, backend=backend, carry_mode="eager", plan=None,
+                kd=None,
+                mul=partial(secp_fe_mul, backend=backend),
+                sq=partial(secp_fe_sq, backend=backend),
+                inv=partial(secp_fe_inv, backend=backend),
+                add=secp_fe_add, sub=secp_fe_sub, carry=secp_fe_carry,
+                mul_small=secp_fe_mul_small,
+            )
+        plan = derive_carry_plan(curve, backend)
+        mul = partial(secp_fe_mul_lazy, wide=plan.mulf_wide,
+                      fix=plan.mulf_fix, backend=backend, mid=plan.mid)
         return SimpleNamespace(
-            curve=curve, backend=backend,
-            mul=partial(secp_fe_mul, backend=backend),
-            sq=partial(secp_fe_sq, backend=backend),
-            inv=partial(secp_fe_inv, backend=backend),
-            add=secp_fe_add, sub=secp_fe_sub, carry=secp_fe_carry,
-            mul_small=secp_fe_mul_small,
+            curve=curve, backend=backend, carry_mode="lazy", plan=plan,
+            kd=np.asarray(plan.kd, np.uint32),
+            mul=mul,
+            mul_lazy=partial(secp_fe_mul_lazy, wide=plan.mull_wide,
+                             fix=plan.mull_fix, backend=backend, mid=plan.mid),
+            sq=lambda a: mul(a, a),
+            inv=partial(secp_fe_inv, mul=mul),
+            add=lambda a, b: secp_fe_norm1(a + b, wide=plan.norm_wide,
+                                           fix=plan.norm_fix),
+            sub=lambda a, b, k: secp_fe_norm1(a + k - b, wide=plan.norm_wide,
+                                              fix=plan.norm_fix),
+            add_raw=lambda a, b: a + b,
+            mul_small=lambda a, k: secp_fe_norm1(a * k, wide=plan.norm_wide,
+                                                 fix=plan.norm_fix),
+            carry=secp_fe_carry,
         )
     raise ValueError(f"unknown curve {curve!r}")
 
@@ -508,6 +816,23 @@ def normalize_backend(value) -> str:
     if v not in FE_BACKENDS:
         raise ValueError(f"[verify] fe_backend must be one of {FE_BACKENDS}, got {value!r}")
     return v
+
+
+def normalize_carry_mode(value) -> str:
+    """Config/env -> carry mode ('' / None / 'auto' mean lazy, the default)."""
+    v = (value or "lazy").strip().lower()
+    if v in ("", "auto"):
+        v = "lazy"
+    if v not in CARRY_MODES:
+        raise ValueError(f"carry mode must be one of {CARRY_MODES}, got {value!r}")
+    return v
+
+
+def effective_carry_mode(backend: str, carry_mode: str = "lazy") -> str:
+    """mxu16's fused 16-limb pipeline has its own carry schedule and no lazy
+    variant — it degrades gracefully to eager; everything else honors the
+    requested mode."""
+    return "eager" if backend == "mxu16" else carry_mode
 
 
 # ---------------------------------------------------------------------------
@@ -545,9 +870,12 @@ def bound_mul_columns(ba: Sequence[int], bb: Sequence[int], out_rows: int) -> Li
 
 
 def bound_fe_mul(curve: str, ba: Sequence[int], bb: Sequence[int],
-                 backend: str = "vpu") -> Tuple[List[int], int]:
+                 backend: str = "vpu", tail_rounds: int = None
+                 ) -> Tuple[List[int], int]:
     """Per-row output maxima of fe_mul plus the largest intermediate the
-    pipeline can produce (callers assert < 2^32)."""
+    pipeline can produce (callers assert < 2^32).  tail_rounds overrides the
+    module's final-carry count so derive_eager_rounds can search for the
+    minimum (None -> the constant the jnp op uses)."""
     hi_in = max(max(ba), max(bb))
     peak = 0
 
@@ -571,8 +899,9 @@ def bound_fe_mul(curve: str, ba: Sequence[int], bb: Sequence[int],
         prod = see([min(b, MASK) + s for b, s in
                     zip(cols, [0] + c[:-1])])
         lo = see([prod[k] + prod[NLIMB + k] * ED_FOLD for k in range(NLIMB)])
-        for _ in range(2):
-            lo, m = _b_carry_round(lo, ((0, ED_FOLD, 0),))
+        rounds = ED_MUL_TAIL_ROUNDS if tail_rounds is None else tail_rounds
+        for _ in range(rounds):
+            lo, m = _b_carry_round(lo, ED_WRAP)
             peak = max(peak, m)
         return lo, peak
     if curve == "secp256k1":
@@ -599,9 +928,9 @@ def bound_fe_mul(curve: str, ba: Sequence[int], bb: Sequence[int],
             lo[t_idx] += t * SECP_FOLD_SMALL
             lo[t_idx + 2] += t << SECP_FOLD_SHIFT
         see(lo)
-        for _ in range(5):
-            lo, m = _b_carry_round(
-                lo, ((0, SECP_FOLD_SMALL, 0), (2, 1, SECP_FOLD_SHIFT)))
+        rounds = SECP_MUL_TAIL_ROUNDS if tail_rounds is None else tail_rounds
+        for _ in range(rounds):
+            lo, m = _b_carry_round(lo, SECP_WRAP)
             peak = max(peak, m)
         return lo, peak
     raise ValueError(curve)
@@ -712,26 +1041,50 @@ def _bound_mul16(curve, ba, bb) -> Tuple[List[int], int]:
     return limbs, peak
 
 
-def bound_fe_add(curve: str, ba, bb) -> Tuple[List[int], int]:
+def bound_fe_add(curve: str, ba, bb, rounds: int = None) -> Tuple[List[int], int]:
     x = [a + b for a, b in zip(ba, bb)]
     peak = max(x)
-    wrap = ((0, ED_FOLD, 0),) if curve == "ed25519" else (
-        (0, SECP_FOLD_SMALL, 0), (2, 1, SECP_FOLD_SHIFT))
-    rounds = 1 if curve == "ed25519" else 3
+    wrap = ED_WRAP if curve == "ed25519" else SECP_WRAP
+    if rounds is None:
+        rounds = ED_ADD_ROUNDS if curve == "ed25519" else SECP_ADD_ROUNDS
     for _ in range(rounds):
         x, m = _b_carry_round(x, wrap)
         peak = max(peak, m)
     return x, peak
 
 
-def bound_fe_sub(curve: str, ba, bb, ksub: Sequence[int]) -> Tuple[List[int], int]:
-    # worst case ignores the subtraction (b >= 0): a + ksub
-    return bound_fe_add(curve, ba, list(ksub))
+def bound_fe_sub(curve: str, ba, bb, ksub: Sequence[int],
+                 rounds: int = None, check: bool = True
+                 ) -> Tuple[List[int], int]:
+    # worst case ignores the subtraction (b >= 0): a + ksub.  That model
+    # is only sound when ksub dominates the subtrahend limb-for-limb —
+    # otherwise a + ksub - b wraps in uint32 and the result is garbage,
+    # not merely unreduced.  The import-time ksub derivation below fixes
+    # the constants to dominate their own closed set; check=False exists
+    # solely for that derivation's intermediate iterates.
+    if check:
+        assert all(int(k) >= int(b) for k, b in zip(ksub, bb)), (
+            curve, "ksub under-dominates the subtrahend bound")
+    return bound_fe_add(curve, ba, list(ksub), rounds=rounds)
+
+
+def bound_fe_mul_small(curve: str, ba, k: int,
+                       rounds: int = None) -> Tuple[List[int], int]:
+    """Mirror of secp_fe_mul_small: scalar limb scale + carry rounds."""
+    assert curve == "secp256k1"
+    x = [a * k for a in ba]
+    peak = max(x)
+    if rounds is None:
+        rounds = SECP_MUL_SMALL_ROUNDS
+    for _ in range(rounds):
+        x, m = _b_carry_round(x, SECP_WRAP)
+        peak = max(peak, m)
+    return x, peak
 
 
 def bound_closed_set(curve: str, backend: str = "vpu",
-                     ksub: Sequence[int] = (), iters: int = 64
-                     ) -> Tuple[List[int], int]:
+                     ksub: Sequence[int] = (), iters: int = 64,
+                     check_ksub: bool = True) -> Tuple[List[int], int]:
     """Fixed point of the op mix: starting from fresh-input bounds (MASK),
     iterate max(mul, add, sub) until the per-row bounds stop growing.
     Returns (closed-set bounds, peak intermediate).  Non-convergence or a
@@ -741,7 +1094,8 @@ def bound_closed_set(curve: str, backend: str = "vpu",
     for _ in range(iters):
         bm, p1 = bound_fe_mul(curve, bounds, bounds, backend)
         ba, p2 = bound_fe_add(curve, bounds, bounds)
-        bs, p3 = (bound_fe_sub(curve, bounds, bounds, ksub)
+        bs, p3 = (bound_fe_sub(curve, bounds, bounds, ksub,
+                               check=check_ksub)
                   if len(ksub) else (bounds, 0))
         nxt = [max(a, b, c) for a, b, c in zip(bm, ba, bs)]
         peak = max(peak, p1, p2, p3)
@@ -749,3 +1103,514 @@ def bound_closed_set(curve: str, backend: str = "vpu",
             return bounds, peak
         bounds = nxt
     raise AssertionError(f"{curve}/{backend}: carried bounds did not converge")
+
+
+# ---------------------------------------------------------------------------
+# Lazy-op bound mirrors + carry-plan derivation.  derive_carry_plan iterates
+# the exact chain set the lazy kernels execute (every operand-class pairing
+# of mulF/mulL/add1/sub1/add_raw) to a fixed point, producing the certified
+# operand classes:
+#   C — fully reduced outputs (mulF, add1, sub1); every point-op output.
+#   D — deferred mulL outputs (one wide round only); add-only consumers.
+# plus KD, a wide multiple of p with limb_i >= D_i so sub1 against class-D
+# operands stays non-negative in uint32.  derive_eager_rounds runs the same
+# machinery over the EAGER mirrors to find the minimal round count for each
+# eager op — the import-time asserts at the bottom pin the module constants
+# to those derived values.
+# ---------------------------------------------------------------------------
+
+
+def _b_wide_round(bounds, wrap_terms) -> Tuple[List[int], int]:
+    """Mirror of wide_carry_rows (decomposed wrap re-entry)."""
+    c = [b >> BITS for b in bounds]
+    out = [min(b, MASK) for b in bounds]
+    out = [o + s for o, s in zip(out, _b_shift_down(c))]
+    top = c[NLIMB - 1]
+    for row, mult, shift in wrap_terms:
+        out[row] += (min(top, MASK) * mult) << shift
+        out[row + 1] += ((top >> BITS) * mult) << shift
+    return out, max(out)
+
+
+def _b_fix(bounds, rows) -> List[int]:
+    out = list(bounds)
+    for r in rows:
+        c = out[r] >> BITS
+        out[r] = min(out[r], MASK)
+        out[r + 1] += c
+    return out
+
+
+def _b_carry_drop_top(bounds) -> Tuple[List[int], int]:
+    """Mirror of carry_drop_top_rows; proves the dropped top carry is 0."""
+    c = [b >> BITS for b in bounds]
+    assert c[-1] == 0, f"carry_drop_top would lose a bound-{c[-1]} carry"
+    out = [min(b, MASK) + s for b, s in zip(bounds, [0] + c[:-1])]
+    return out, max(out)
+
+
+def bound_ed_fold_fused(cols) -> List[int]:
+    """Mirror of ed_fold_fused_rows; proves the shifted-out hi piece of
+    column 39 is 0 (jnp drops it via shift_rows_down)."""
+    hi = cols[NLIMB:]
+    lo = [cols[k] + min(hi[k], MASK) * ED_FOLD for k in range(NLIMB)]
+    hh = [h >> BITS for h in hi]
+    assert hh[NLIMB - 1] == 0, "ed fused fold would drop a non-zero hi piece"
+    for k in range(NLIMB - 1):
+        lo[k + 1] += hh[k] * ED_FOLD
+    return lo
+
+
+def bound_ed_mul_lazy(ba, bb, wide, fix=(0,)) -> Tuple[List[int], int]:
+    cols = bound_mul_columns(ba, bb, 2 * NLIMB)
+    peak = max(cols)
+    assert cols[2 * NLIMB - 1] == 0  # col 39 is structurally empty
+    lo = bound_ed_fold_fused(cols)
+    peak = max(peak, max(lo))
+    for _ in range(wide):
+        lo, m = _b_wide_round(lo, ED_WRAP)
+        peak = max(peak, m)
+    lo = _b_fix(lo, fix)
+    return lo, max(peak, max(lo))
+
+
+def bound_ed_norm1(raw, fix=(0,)) -> Tuple[List[int], int]:
+    peak = max(raw)
+    lo, m = _b_wide_round(raw, ED_WRAP)
+    lo = _b_fix(lo, fix)
+    return lo, max(peak, m, max(lo))
+
+
+def bound_secp_fold_fused(cols) -> List[int]:
+    """Mirror of secp_fold_fused_rows; proves the c-piece that would land
+    on temp row 24 is 0 (jnp slices it away)."""
+    tmp = list(cols[:NLIMB]) + [0] * 4
+    for k in range(NLIMB + 1):
+        h = cols[NLIMB + k]
+        a, b2, c3 = min(h, MASK), min(h >> BITS, MASK), h >> (2 * BITS)
+        tmp[k] += a * SECP_FOLD_SMALL
+        tmp[k + 1] += b2 * SECP_FOLD_SMALL
+        tmp[k + 2] += c3 * SECP_FOLD_SMALL + (a << SECP_FOLD_SHIFT)
+        tmp[k + 3] += b2 << SECP_FOLD_SHIFT
+        if k + 4 < NLIMB + 4:
+            tmp[k + 4] += c3 << SECP_FOLD_SHIFT
+        else:
+            assert c3 == 0, "secp fused fold would drop a non-zero c piece"
+    return tmp
+
+
+def bound_secp_fold2(tmp) -> List[int]:
+    lo = list(tmp[:NLIMB])
+    for t in range(4):
+        h = tmp[NLIMB + t]
+        a, b2 = min(h, MASK), h >> BITS
+        lo[t] += a * SECP_FOLD_SMALL
+        lo[t + 1] += b2 * SECP_FOLD_SMALL
+        lo[t + 2] += a << SECP_FOLD_SHIFT
+        lo[t + 3] += b2 << SECP_FOLD_SHIFT
+    return lo
+
+
+def bound_secp_mul_lazy(ba, bb, wide, fix=(0, 1, 2, 3),
+                        mid=1) -> Tuple[List[int], int]:
+    cols = bound_mul_columns(ba, bb, 2 * NLIMB + 1)
+    peak = max(cols)
+    assert cols[2 * NLIMB - 1] == 0 and cols[2 * NLIMB] == 0
+    tmp = bound_secp_fold_fused(cols)
+    peak = max(peak, max(tmp))
+    for _ in range(mid):
+        tmp, m = _b_carry_drop_top(tmp)
+        peak = max(peak, m)
+    lo = bound_secp_fold2(tmp)
+    peak = max(peak, max(lo))
+    for _ in range(wide):
+        lo, m = _b_wide_round(lo, SECP_WRAP)
+        peak = max(peak, m)
+    lo = _b_fix(lo, fix)
+    return lo, max(peak, max(lo))
+
+
+def bound_secp_norm1(raw, wide=1, fix=(0, 1, 2, 3)) -> Tuple[List[int], int]:
+    peak = max(raw)
+    lo = list(raw)
+    for _ in range(wide):
+        lo, m = _b_wide_round(lo, SECP_WRAP)
+        peak = max(peak, m)
+    lo = _b_fix(lo, fix)
+    return lo, max(peak, max(lo))
+
+
+def mk_wide_multiple(p: int, floors: Sequence[int], mult0: int
+                     ) -> Tuple[List[int], int]:
+    """Smallest mult0-multiple of p whose radix-13 limbs can be raised (by
+    borrowing 2^13 from the next limb) to limb_i >= floors[i] with every
+    limb still < 2^31 — the wide-zero constant that keeps a - b + K
+    non-negative in uint32 for operands bounded by floors."""
+    mult = mult0
+    while True:
+        v = mult * p
+        limbs = [(v >> (BITS * i)) & MASK for i in range(NLIMB + 2)]
+        limbs[NLIMB - 1] += limbs[NLIMB] << BITS
+        limbs[NLIMB - 1] += limbs[NLIMB + 1] << (2 * BITS)
+        limbs = limbs[:NLIMB]
+        for i in range(NLIMB - 1):
+            if limbs[i] < floors[i]:
+                t = ((floors[i] - limbs[i]) >> BITS) + 1
+                limbs[i] += t << BITS
+                limbs[i + 1] -= t
+        if limbs[NLIMB - 1] >= floors[NLIMB - 1] and all(
+                0 <= l < (1 << 31) for l in limbs):
+            assert sum(l << (BITS * i) for i, l in enumerate(limbs)) % p == 0
+            return limbs, mult
+        mult += mult0
+        assert mult < mult0 * 10000, "no wide multiple of p fits the floors"
+
+
+# The eager wide-zero constants the kernels already use, re-derived here so
+# the bound machinery and the lazy sub1 paths share one source of truth
+# (tests assert these equal the verify modules' _K_SUB arrays).
+ED_KSUB_LIMBS = [4 * MASK - 2428] + [4 * MASK] * (NLIMB - 1)
+assert sum(v << (BITS * i) for i, v in enumerate(ED_KSUB_LIMBS)) % ED_P == 0
+
+
+def _dominating_ksub(curve: str, prime: int, mult0: int) -> List[int]:
+    """Wide zero whose limbs dominate the eager closed set it induces.
+
+    The floor and the closed set are mutually dependent (sub's output
+    bound is a + ksub), so iterate: derive a candidate from the current
+    floor, recompute the closed set under it, and raise the floor to any
+    limb the set exceeds.  A flat 2*MASK floor is NOT enough — the wrap
+    fold can carry limb 0 up to MASK + fold (23823 on secp256k1), past
+    the old hand-picked constant's 19392, and an under-dominated ksub
+    makes a + ksub - b wrap in uint32."""
+    floor = [2 * MASK] * NLIMB
+    for _ in range(8):
+        ks, _ = mk_wide_multiple(prime, floor, mult0)
+        cs, _ = bound_closed_set(curve, "vpu", ksub=tuple(ks),
+                                 check_ksub=False)
+        if all(k >= b for k, b in zip(ks, cs)):
+            return ks
+        floor = [max(f, b) for f, b in zip(floor, cs)]
+    raise AssertionError(f"{curve}: ksub/closed-set domination diverged")
+
+
+SECP_KSUB_LIMBS = _dominating_ksub("secp256k1", SECP_P, 64)
+# ed25519's 4*MASK floor already dominates its closed set — assert rather
+# than trust (same soundness condition as the secp derivation above)
+_ED_CS_CHECK, _ = bound_closed_set("ed25519", "vpu",
+                                   ksub=tuple(ED_KSUB_LIMBS))
+assert all(k >= b for k, b in zip(ED_KSUB_LIMBS, _ED_CS_CHECK))
+del _ED_CS_CHECK
+
+
+def _ed_lazy_closed(mulf_wide: int):
+    """Fixed point of the ed25519 lazy chain set (see derive_carry_plan)."""
+    peak = 0
+    C = [MASK] * NLIMB
+    KD = kd_floor = kd_mult = None
+    for it in range(300):
+        raw_cc = [x + y for x, y in zip(C, C)]
+        d1, p1 = bound_ed_mul_lazy(C, C, wide=1)
+        d2, p2 = bound_ed_mul_lazy(raw_cc, C, wide=1)
+        # widen D to cover C row-wise so a class-C operand may always stand
+        # in where the chain shapes below were certified with class D
+        D = [max(a, b, c) for a, b, c in zip(d1, d2, C)]
+        if KD is None or any(d > f for d, f in zip(D, kd_floor)):
+            kd_floor = [max(1 << 18, d) for d in D]
+            KD, kd_mult = mk_wide_multiple(ED_P, kd_floor, 32)
+        raw_dd = [x + y for x, y in zip(D, D)]
+        outs = [bound_ed_mul_lazy(C, C, wide=mulf_wide)]
+        for raw in (
+            [x + y for x, y in zip(C, C)],          # add1(C, C)
+            [x + k for x, k in zip(C, ED_KSUB_LIMBS)],  # sub1(C, C)
+            [x + y for x, y in zip(D, D)],          # add1(D, D)
+            [x + k for x, k in zip(D, KD)],         # sub1(D, D)
+            [x + k for x, k in zip(C, KD)],         # sub1(C, D)
+            [x + y for x, y in zip(raw_dd, C)],     # add1(add_raw(D,D), C)
+            [r + k for r, k in zip(raw_cc, KD)],    # sub1(add_raw(C,C), D)
+            [r + c for r, c in zip(raw_cc, C)],     # add1(add_raw(C,C), C)
+        ):
+            outs.append(bound_ed_norm1(raw))
+        peak = max([peak, p1, p2] + [p for _, p in outs])
+        nxt = [max(vals) for vals in zip(*(b for b, _ in outs))]
+        if nxt == C:
+            return C, D, KD, kd_mult, peak, it
+        if max(nxt) > 10 ** 7:
+            return None, None, None, None, peak, it
+        C = nxt
+    return None, None, None, None, peak, it
+
+
+def _secp_lazy_closed(mulf_wide: int):
+    """Fixed point of the secp256k1 RCB16 lazy chain set."""
+    peak = 0
+    C = [MASK] * NLIMB
+    KD = kd_floor = kd_mult = None
+    for it in range(300):
+        CC = [x + y for x, y in zip(C, C)]
+        C1, pc = bound_secp_norm1(CC)
+        d1, p1 = bound_secp_mul_lazy(C, C, wide=1, fix=(0,))
+        d2, p2 = bound_secp_mul_lazy(C1, CC, wide=1, fix=(0,))
+        d3, p3 = bound_secp_mul_lazy(C, CC, wide=1, fix=(0,))
+        # widen D to cover C row-wise (same substitution lemma as ed25519)
+        D = [max(vals) for vals in zip(d1, d2, d3, C)]
+        DD = [x + y for x, y in zip(D, D)]
+        if KD is None or any(d > f for d, f in zip(DD, kd_floor)):
+            kd_floor = [max(1 << 18, d) for d in DD]
+            KD, kd_mult = mk_wide_multiple(SECP_P, kd_floor, 16)
+        outs = [bound_secp_mul_lazy(C, C, wide=mulf_wide), (C1, pc)]
+        for raw in (
+            [x + k for x, k in zip(C, SECP_KSUB_LIMBS)],       # sub1(C, C)
+            [d + k + s for d, k, s in zip(D, KD, DD)],         # sub1(D, add_raw(D,D))
+            [s + d for s, d in zip(DD, D)],                    # add1(add_raw(D,D), D)
+            [x * B3_SMALL for x in C],                         # mul_small1(C)
+            [d + c for d, c in zip(D, C)],                     # add1(D, C)
+            [d + k + c for d, k, c in zip(D, KD, C)],          # sub1(D, C)
+            [a + k + b for a, k, b in zip(D, KD, D)],          # sub1(D, D)
+            [x + y for x, y in zip(D, D)],                     # add1(D, D)
+        ):
+            outs.append(bound_secp_norm1(raw))
+        peak = max([peak, pc, p1, p2, p3] + [p for _, p in outs])
+        nxt = [max(vals) for vals in zip(*(b for b, _ in outs))]
+        if nxt == C:
+            return C, D, KD, kd_mult, peak, it
+        if max(nxt) > 10 ** 7:
+            return None, None, None, None, peak, it
+        C = nxt
+    return None, None, None, None, peak, it
+
+
+B3_SMALL = 21  # 3*b of the secp256k1 curve equation, RCB16's only scalar
+
+
+@lru_cache(maxsize=None)
+def derive_carry_plan(curve: str, backend: str = "vpu") -> SimpleNamespace:
+    """Certified lazy carry plan: iterate the kernel's deferred-carry chain
+    set to a fixed point and return the operand classes, KD constant, and
+    per-op round/fixup schedule.  The mulF wide count is SEARCHED (smallest
+    that converges), not stated.  Raises for mxu16 — callers degrade it to
+    eager via effective_carry_mode."""
+    if backend == "mxu16":
+        raise ValueError("mxu16 has no lazy carry plan; use effective_carry_mode")
+    if backend not in FE_BACKENDS:
+        raise ValueError(f"fe backend must be one of {FE_BACKENDS}, got {backend!r}")
+    closed = _ed_lazy_closed if curve == "ed25519" else _secp_lazy_closed
+    if curve not in ("ed25519", "secp256k1"):
+        raise ValueError(f"unknown curve {curve!r}")
+    for mulf_wide in range(1, 5):
+        C, D, KD, kd_mult, peak, iters = closed(mulf_wide)
+        if C is not None:
+            break
+    else:
+        raise AssertionError(f"{curve}: lazy chain set never converged")
+    assert peak < U32, f"{curve} lazy peak {peak:.3e} overflows uint32"
+    if backend == "mxu":
+        # lazy-mode multiply operands (C and raw C+C sums) must fit the
+        # uint8 plane split the lazy ops pin (split=8)
+        worst = 2 * max(C) if curve == "ed25519" else max(
+            max(C) * 2, max(bound_secp_norm1([2 * c for c in C])[0]))
+        assert worst <= 65535, f"{curve} mxu lazy operands reach {worst}"
+    ksub = ED_KSUB_LIMBS if curve == "ed25519" else SECP_KSUB_LIMBS
+    eager_cs, _ = bound_closed_set(curve, "vpu", tuple(ksub))
+    # Epilogue certificate: eager ops must accept class-C inputs.  Close the
+    # eager op mix seeded at max(C, eager closed set) — this is the domain
+    # the eager fe_inv / fe_canonical chains see when fed lazy outputs.
+    cs_epi = [max(a, b) for a, b in zip(C, eager_cs)]
+    epi_peak = 0
+    for _ in range(64):
+        bm, p1 = bound_fe_mul(curve, cs_epi, cs_epi, "vpu")
+        ba, p2 = bound_fe_add(curve, cs_epi, cs_epi)
+        bs, p3 = bound_fe_sub(curve, cs_epi, cs_epi, ksub)
+        nxt = [max(vals) for vals in zip(bm, ba, bs)]
+        epi_peak = max(epi_peak, p1, p2, p3)
+        if nxt == cs_epi:
+            break
+        cs_epi = nxt
+    else:
+        raise AssertionError(f"{curve}: epilogue closure did not converge")
+    assert epi_peak < U32
+    if backend == "mxu":
+        # the XLA eager epilogue keeps the curve's plane split (7 for ed)
+        limit = 16383 if curve == "ed25519" else 65535
+        assert max(cs_epi) <= limit, (
+            f"{curve} mxu eager epilogue operands reach {max(cs_epi)}")
+    if curve == "ed25519":
+        assert max(cs_epi) <= ED_M, (
+            f"ed25519 epilogue limbs {max(cs_epi)} leave _canonical_ref's "
+            f"certified domain (<= {ED_M})")
+    # Canonical-encode prologue certificate: two eager carry rounds bring
+    # any epilogue-class value back inside the eager closed set (the domain
+    # the canonical-reduction tests drive).
+    back = cs_epi
+    for _ in range(2):
+        back, _ = _b_carry_round(
+            back, ED_WRAP if curve == "ed25519" else SECP_WRAP)
+    assert all(a <= b for a, b in zip(back, eager_cs)), (
+        f"{curve}: lazy outputs do not re-enter the eager closed set")
+    # C <= D row-wise lets chains substitute a class-C operand where the
+    # certification used class D (e.g. add1(add_raw(C,C), D) is dominated by
+    # the certified add1(add_raw(D,D), C)).
+    assert all(a <= b for a, b in zip(C, D)), f"{curve}: class C exceeds D"
+    if curve == "ed25519":
+        return SimpleNamespace(
+            curve=curve, backend=backend, c=C, d=D, kd=KD, kd_mult=kd_mult,
+            ksub=list(ksub), mulf_wide=mulf_wide, mull_wide=1, norm_wide=1,
+            mid=0, mulf_fix=(0,), mull_fix=(0,), norm_fix=(0,), split=8,
+            peak=peak, iters=iters)
+    return SimpleNamespace(
+        curve=curve, backend=backend, c=C, d=D, kd=KD, kd_mult=kd_mult,
+        ksub=list(ksub), mulf_wide=mulf_wide, mull_wide=1, norm_wide=1,
+        mid=1, mulf_fix=(0, 1, 2, 3), mull_fix=(0,), norm_fix=(0, 1, 2, 3),
+        split=8, peak=peak, iters=iters)
+
+
+@lru_cache(maxsize=None)
+def derive_eager_rounds(curve: str) -> dict:
+    """Minimal eager carry rounds per op: smallest r whose output on
+    closed-set inputs stays inside the closed set with every intermediate
+    < 2^32.  The import-time asserts below pin the module constants (and so
+    the jnp ops) to exactly these values."""
+    ksub = ED_KSUB_LIMBS if curve == "ed25519" else SECP_KSUB_LIMBS
+    cs, _ = bound_closed_set(curve, "vpu", tuple(ksub))
+
+    def minimal(op):
+        for r in range(1, 9):
+            out, pk = op(r)
+            if pk < U32 and all(o <= c for o, c in zip(out, cs)):
+                return r
+        raise AssertionError(f"{curve}: no round count <= 8 closes the set")
+
+    derived = {
+        "mul_tail": minimal(
+            lambda r: bound_fe_mul(curve, cs, cs, "vpu", tail_rounds=r)),
+        "add": minimal(lambda r: bound_fe_add(curve, cs, cs, rounds=r)),
+        "sub": minimal(lambda r: bound_fe_sub(curve, cs, cs, ksub, rounds=r)),
+    }
+    if curve == "secp256k1":
+        derived["mul_small"] = minimal(
+            lambda r: bound_fe_mul_small(curve, cs, B3_SMALL, rounds=r))
+    return derived
+
+
+# ---------------------------------------------------------------------------
+# Carry-round cost model — the three pools (multiply / deferred-carry /
+# final-fold) in row-slot units: one limb-row processed by one carry round
+# costs 1.  Per-op costs come from the certified schedules above; the op
+# mixes are the literal op counts of the point formulas in the Pallas
+# kernels.  PERF.md and the >= 30% acceptance gate in tests read from here.
+# ---------------------------------------------------------------------------
+
+_ED_POINT_MIX = {
+    "eager": {
+        "pt_double":     {"mul": 8, "addsub": 6},
+        "pt_madd":       {"mul": 7, "addsub": 7},
+        "pt_add_cached": {"mul": 9, "addsub": 9},
+        "pt_add_ext":    {"mul": 9, "addsub": 9},
+        "niels_convert": {},
+    },
+    "lazy": {
+        "pt_double":     {"mulF": 4, "mulL": 4, "norm1": 5},
+        "pt_madd":       {"mulF": 4, "mulL": 3, "norm1": 5},
+        "pt_add_cached": {"mulF": 4, "mulL": 4, "norm1": 5},
+        "pt_add_ext":    {"mulF": 5, "mulL": 4, "norm1": 8},
+        "niels_convert": {"mulF": 1, "norm1": 2},
+    },
+}
+
+_SECP_POINT_MIX = {
+    "eager": {"pt_add": {"mul": 12, "mul_small": 2, "addsub": 18}},
+    "lazy": {"pt_add": {"mulF": 1, "mulL": 11, "norm1": 12, "mul_small": 2}},
+}
+
+
+def _carry_op_costs(curve: str, carry_mode: str) -> dict:
+    if curve == "ed25519":
+        if carry_mode == "eager":
+            return {"mul": (2 + ED_MUL_TAIL_ROUNDS) * NLIMB,
+                    "addsub": ED_ADD_ROUNDS * NLIMB}
+        plan = derive_carry_plan(curve)
+        return {
+            "mulF": plan.mulf_wide * NLIMB + len(plan.mulf_fix),
+            "mulL": plan.mull_wide * NLIMB + len(plan.mull_fix),
+            "norm1": plan.norm_wide * NLIMB + len(plan.norm_fix),
+        }
+    if curve == "secp256k1":
+        if carry_mode == "eager":
+            return {
+                "mul": 3 * (2 * NLIMB + 1) + 2 * (NLIMB + 4)
+                + SECP_MUL_TAIL_ROUNDS * NLIMB,
+                "addsub": SECP_ADD_ROUNDS * NLIMB,
+                "mul_small": SECP_MUL_SMALL_ROUNDS * NLIMB,
+            }
+        plan = derive_carry_plan(curve)
+        norm1 = plan.norm_wide * NLIMB + len(plan.norm_fix)
+        return {
+            "mulF": plan.mid * (NLIMB + 4) + plan.mulf_wide * NLIMB
+            + len(plan.mulf_fix),
+            "mulL": plan.mid * (NLIMB + 4) + plan.mull_wide * NLIMB
+            + len(plan.mull_fix),
+            "norm1": norm1,
+            "mul_small": norm1,
+        }
+    raise ValueError(curve)
+
+
+def carry_cost_model(curve: str = "ed25519", carry_mode: str = "lazy") -> dict:
+    """Per-signature carry-round cost in row-slots (see module comment).
+    Composition mirrors the Pallas kernels: 64 windows of 4 doubles + 1
+    niels madd + 1 table add for ed25519 (plus table build, cached-table
+    conversion under lazy, and the 265-mul inversion); 64 windows of 6
+    RCB16 adds for secp256k1 (plus the 15-add table and the inversion-free
+    projective epilogue)."""
+    if carry_mode not in CARRY_MODES:
+        raise ValueError(f"carry mode must be one of {CARRY_MODES}, got {carry_mode!r}")
+    costs = _carry_op_costs(curve, carry_mode)
+
+    def op(mix):
+        return sum(costs[k] * n for k, n in mix.items())
+
+    mul1 = costs["mulF" if carry_mode == "lazy" else "mul"]
+    if curve == "ed25519":
+        mix = _ED_POINT_MIX[carry_mode]
+        point = {name: op(m) for name, m in mix.items()}
+        window = 4 * point["pt_double"] + point["pt_madd"] + point["pt_add_cached"]
+        table = (mul1 + 7 * point["pt_double"] + 7 * point["pt_add_ext"]
+                 + 16 * point["niels_convert"])
+        inv = 265 * mul1
+        per_sig = 64 * window + table + inv
+        return {
+            "curve": curve, "carry_mode": carry_mode, "unit": "row-slots",
+            "per_op": costs, "per_point_op": point, "per_window": window,
+            "table": table, "inv": inv, "per_signature": per_sig,
+        }
+    if curve == "secp256k1":
+        mix = _SECP_POINT_MIX[carry_mode]
+        point = {name: op(m) for name, m in mix.items()}
+        window = 6 * point["pt_add"]
+        table = 15 * point["pt_add"]
+        epilogue = 2 * mul1 + 2 * costs["norm1" if carry_mode == "lazy"
+                                        else "addsub"]
+        per_sig = 64 * window + table + epilogue
+        return {
+            "curve": curve, "carry_mode": carry_mode, "unit": "row-slots",
+            "per_op": costs, "per_point_op": point, "per_window": window,
+            "table": table, "inv": epilogue, "per_signature": per_sig,
+        }
+    raise ValueError(curve)
+
+
+# Satellite 1 (executed docstring proofs): the eager round constants above
+# must be exactly the minimal counts the bound propagators derive.
+_ED_EAGER_DERIVED = derive_eager_rounds("ed25519")
+assert _ED_EAGER_DERIVED == {
+    "mul_tail": ED_MUL_TAIL_ROUNDS,
+    "add": ED_ADD_ROUNDS,
+    "sub": ED_ADD_ROUNDS,
+}, f"ed25519 eager rounds drifted: derived {_ED_EAGER_DERIVED}"
+_SECP_EAGER_DERIVED = derive_eager_rounds("secp256k1")
+assert _SECP_EAGER_DERIVED == {
+    "mul_tail": SECP_MUL_TAIL_ROUNDS,
+    "add": SECP_ADD_ROUNDS,
+    "sub": SECP_ADD_ROUNDS,
+    "mul_small": SECP_MUL_SMALL_ROUNDS,
+}, f"secp256k1 eager rounds drifted: derived {_SECP_EAGER_DERIVED}"

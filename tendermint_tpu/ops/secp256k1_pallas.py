@@ -72,8 +72,16 @@ _K_SUB = _xla._K_SUB
 
 from tendermint_tpu.ops import fe_common as _fc
 
-_FE = {b: _fc.make_fe("secp256k1", b) for b in _fc.FE_BACKENDS}
-_FE_VPU = _FE["vpu"]
+_FE = {(b, "eager"): _fc.make_fe("secp256k1", b) for b in _fc.FE_BACKENDS}
+_FE_VPU = _FE[("vpu", "eager")]
+
+
+def _get_fe(backend: str, carry_mode: str = "eager"):
+    mode = _fc.effective_carry_mode(backend, carry_mode)
+    key = (backend, mode)
+    if key not in _FE:
+        _FE[key] = _fc.make_fe("secp256k1", backend, carry_mode=mode)
+    return _FE[key]
 
 # backward-compatible module-level surface (tests/test_ops_secp256k1.py and
 # the XLA kernel's parity checks import these directly)
@@ -91,9 +99,11 @@ fe_mul_small = _fc.secp_fe_mul_small
 # ---------------------------------------------------------------------------
 
 
-def pt_add(p, q, ksub, fe=_FE_VPU):
+def pt_add(p, q, ksub, fe=_FE_VPU, kd=None):
     X1, Y1, Z1 = p
     X2, Y2, Z2 = q
+    if fe.carry_mode == "lazy":
+        return _pt_add_lazy(p, q, fe, kd)
     t0 = fe.mul(X1, X2)
     t1 = fe.mul(Y1, Y2)
     t2 = fe.mul(Z1, Z2)
@@ -114,15 +124,45 @@ def pt_add(p, q, ksub, fe=_FE_VPU):
     return X3, Y3, Z3
 
 
+def _pt_add_lazy(p, q, fe, kd):
+    """RCB16 with deferred carries: point coordinates stay in the certified
+    class C; multiply outputs ride as class D between the single-round
+    norm1 folds. 12 of 14 fe_muls drop to the one-wide-round mulL tail; the
+    per-op chain is certified by fe_common.derive_carry_plan at import."""
+    if kd is None:
+        kd = jnp.asarray(fe.kd)[:, None]
+    X1, Y1, Z1 = p
+    X2, Y2, Z2 = q
+    t0 = fe.mul_lazy(X1, X2)                               # D
+    t1 = fe.mul_lazy(Y1, Y2)                               # D
+    t2 = fe.mul(Z1, Z2)                                    # C (feeds mul_small)
+    t3 = fe.sub(fe.mul_lazy(fe.add(X1, Y1), fe.add_raw(X2, Y2)),
+                fe.add_raw(t0, t1), kd)                    # C
+    t4 = fe.sub(fe.mul_lazy(fe.add(Y1, Z1), fe.add_raw(Y2, Z2)),
+                fe.add_raw(t1, t2), kd)                    # C
+    X3 = fe.mul_lazy(fe.add(X1, Z1), fe.add_raw(X2, Z2))   # D
+    Y3 = fe.sub(X3, fe.add_raw(t0, t2), kd)                # C
+    t0x3 = fe.add(fe.add_raw(t0, t0), t0)                  # C
+    t2b = fe.mul_small(t2, B3)                             # C
+    Z3 = fe.add(t1, t2b)                                   # C
+    t1 = fe.sub(t1, t2b, kd)                               # C
+    Y3b = fe.mul_small(Y3, B3)                             # C
+    X3 = fe.sub(fe.mul_lazy(t3, t1), fe.mul_lazy(t4, Y3b), kd)
+    Y3 = fe.add(fe.mul_lazy(Y3b, t0x3), fe.mul_lazy(t1, Z3))
+    Z3 = fe.add(fe.mul_lazy(Z3, t4), fe.mul_lazy(t0x3, t3))
+    return X3, Y3, Z3
+
+
 # ---------------------------------------------------------------------------
 # Constant table: [0..15]·G projective, identity (0:1:0) at digit 0
 # ---------------------------------------------------------------------------
 
 
 def _build_g_table() -> np.ndarray:
-    """(20, 49) uint32 consts input: cols 0..15 = Gx of j·G, 16..31 = Gy,
-    32..47 = Gz (1, or 0 for the identity), 48 = the fe_sub K constant."""
-    out = np.zeros((NLIMB, 49), dtype=np.uint32)
+    """(20, 50) uint32 consts input: cols 0..15 = Gx of j·G, 16..31 = Gy,
+    32..47 = Gz (1, or 0 for the identity), 48 = the fe_sub K constant,
+    49 = the lazy-mode KD constant (dominates class-D operands)."""
+    out = np.zeros((NLIMB, 50), dtype=np.uint32)
     for j in range(16):
         if j == 0:
             x, y, z = 0, 1, 0
@@ -133,6 +173,7 @@ def _build_g_table() -> np.ndarray:
         out[:, 16 + j] = int_to_limbs(y)
         out[:, 32 + j] = int_to_limbs(z)
     out[:, 48] = _K_SUB
+    out[:, 49] = np.asarray(_fc.derive_carry_plan("secp256k1").kd, np.uint32)
     return out
 
 
@@ -180,7 +221,8 @@ def _canonical_ref(v, s1, s2):
 
 
 def ladder_math(consts, qx, qy, dig1_get, dig2_get, nwin: int = NWIN,
-                loop=lax.fori_loop, fe_backend: str = "vpu"):
+                loop=lax.fori_loop, fe_backend: str = "vpu",
+                carry_mode: str = "lazy"):
     """The windowed-Straus double-scalar multiply u1·G + u2·Q — pure jnp,
     shared by the pallas kernel (on ref values) and the CPU parity tests.
     dig1_get/dig2_get: t -> (1, B) digit row accessors (a ref slice
@@ -188,12 +230,17 @@ def ladder_math(consts, qx, qy, dig1_get, dig2_get, nwin: int = NWIN,
     code with small scalars, and tests swap `loop` for a plain Python loop
     to evaluate eagerly (XLA's CPU compile of this graph thrashes for
     ~10 min in the simplifier). fe_backend picks the limb multiplier
-    (fe_common.FE_BACKENDS). Returns projective (X, Y, Z)."""
-    fe = _FE[fe_backend]
+    (fe_common.FE_BACKENDS); carry_mode "lazy" defers carries between
+    point ops per fe_common.derive_carry_plan. Returns projective
+    (X, Y, Z) — coordinates land in the certified class C under lazy,
+    congruent mod p to the eager result."""
+    mode = _fc.effective_carry_mode(fe_backend, carry_mode)
+    fe = _get_fe(fe_backend, mode)
     B = qx.shape[1]
     zero = jnp.zeros((NLIMB, B), jnp.uint32)
     one = jnp.pad(jnp.ones((1, B), jnp.uint32), ((0, NLIMB - 1), (0, 0)))
     ksub = consts[:, 48:49]
+    kd = consts[:, 49:50] if mode == "lazy" else None
 
     q1 = (qx, qy, one)
     ident = (zero, one, zero)  # (0:1:0)
@@ -202,7 +249,7 @@ def ladder_math(consts, qx, qy, dig1_get, dig2_get, nwin: int = NWIN,
     # identity at j=0, so tbl[1] = ident + Q = Q needs no special case
     tbl = [ident]
     for j in range(1, 16):
-        tbl.append(pt_add(tbl[j - 1], q1, ksub, fe))
+        tbl.append(pt_add(tbl[j - 1], q1, ksub, fe, kd))
     tbl_x = jnp.stack([t[0] for t in tbl])  # (16, 20, B)
     tbl_y = jnp.stack([t[1] for t in tbl])
     tbl_z = jnp.stack([t[2] for t in tbl])
@@ -215,7 +262,8 @@ def ladder_math(consts, qx, qy, dig1_get, dig2_get, nwin: int = NWIN,
 
     def body(t, acc):
         for _ in range(4):
-            acc = pt_add(acc, acc, ksub, fe)  # the complete law doubles too
+            # the complete law doubles too
+            acc = pt_add(acc, acc, ksub, fe, kd)
         d1 = dig1_get(t)  # (1, B)
         d2 = dig2_get(t)
         mk1 = [(d1 == j).astype(jnp.uint32) for j in range(16)]
@@ -223,10 +271,10 @@ def ladder_math(consts, qx, qy, dig1_get, dig2_get, nwin: int = NWIN,
         gx = sum(consts[:, j : j + 1] * mk1[j] for j in range(16))
         gy = sum(consts[:, 16 + j : 17 + j] * mk1[j] for j in range(16))
         gz = sum(consts[:, 32 + j : 33 + j] * mk1[j] for j in range(16))
-        acc = pt_add(acc, (gx, gy, gz), ksub, fe)
+        acc = pt_add(acc, (gx, gy, gz), ksub, fe, kd)
         q_sel = (select16(tbl_x, mk2), select16(tbl_y, mk2),
                  select16(tbl_z, mk2))
-        acc = pt_add(acc, q_sel, ksub, fe)
+        acc = pt_add(acc, q_sel, ksub, fe, kd)
         return acc
 
     return loop(0, nwin, body, ident)
@@ -234,7 +282,7 @@ def ladder_math(consts, qx, qy, dig1_get, dig2_get, nwin: int = NWIN,
 
 def _ladder_kernel(consts_ref, qx_ref, qy_ref, dig1_ref, dig2_ref,
                    rl_ref, rnl_ref, rnok_ref, out_ref, s1, s2,
-                   fe_backend: str = "vpu"):
+                   fe_backend: str = "vpu", carry_mode: str = "lazy"):
     consts = consts_ref[:]
     ksub = consts[:, 48:49]
     X, _Y, Z = ladder_math(
@@ -243,31 +291,37 @@ def _ladder_kernel(consts_ref, qx_ref, qy_ref, dig1_ref, dig2_ref,
         lambda t: dig2_ref[pl.ds(t, 1), :],
         nwin=dig1_ref.shape[0],
         fe_backend=fe_backend,
+        carry_mode=carry_mode,
     )
 
-    fe = _FE[fe_backend]
+    mode = _fc.effective_carry_mode(fe_backend, carry_mode)
+    fe = _get_fe(fe_backend, mode)
+    # Under lazy, X/Z sit in the certified class C and fe.sub's norm1
+    # output re-enters the eager closed set after _canonical_ref's two
+    # opening carry rounds (the re-entry certificate in derive_carry_plan).
+    ks = consts[:, 49:50] if mode == "lazy" else ksub
     z_can = _canonical_ref(Z, s1, s2)
     nonzero = jnp.any(z_can != 0, axis=0, keepdims=True)
     # x(R) ≡ r  ⇔  X ≡ r·Z  (Z ≠ 0); same for the r+n representative
-    d_r = _canonical_ref(fe.sub(X, fe.mul(rl_ref[:], Z), ksub), s1, s2)
+    d_r = _canonical_ref(fe.sub(X, fe.mul(rl_ref[:], Z), ks), s1, s2)
     eq_r = jnp.all(d_r == 0, axis=0, keepdims=True)
-    d_rn = _canonical_ref(fe.sub(X, fe.mul(rnl_ref[:], Z), ksub), s1, s2)
+    d_rn = _canonical_ref(fe.sub(X, fe.mul(rnl_ref[:], Z), ks), s1, s2)
     eq_rn = jnp.all(d_rn == 0, axis=0, keepdims=True) & (rnok_ref[:] != 0)
     out_ref[:] = (nonzero & (eq_r | eq_rn)).astype(jnp.uint32)
 
 
 def _ladder_call(qx, qy, dig1, dig2, rl, rnl, rnok, *, interpret=False,
-                 lanes=LANES, fe_backend="vpu"):
+                 lanes=LANES, fe_backend="vpu", carry_mode="lazy"):
     """qx/qy/rl/rnl (20, N); dig1/dig2 (nwin, N) — NWIN=64 in production,
     fewer in the reduced interpret tests; rnok (1, N); N % lanes == 0."""
     n = qx.shape[1]
     nwin = dig1.shape[0]
-    cspec = pl.BlockSpec((NLIMB, 49), lambda i: (0, 0), memory_space=pltpu.VMEM)
+    cspec = pl.BlockSpec(_CONSTS.shape, lambda i: (0, 0), memory_space=pltpu.VMEM)
     spec20 = pl.BlockSpec((NLIMB, lanes), lambda i: (0, i), memory_space=pltpu.VMEM)
     spec64 = pl.BlockSpec((nwin, lanes), lambda i: (0, i), memory_space=pltpu.VMEM)
     spec1 = pl.BlockSpec((1, lanes), lambda i: (0, i), memory_space=pltpu.VMEM)
     return pl.pallas_call(
-        partial(_ladder_kernel, fe_backend=fe_backend),
+        partial(_ladder_kernel, fe_backend=fe_backend, carry_mode=carry_mode),
         out_shape=jax.ShapeDtypeStruct((1, n), jnp.uint32),
         grid=(n // lanes,),
         in_specs=[cspec, spec20, spec20, spec64, spec64, spec20, spec20, spec1],
@@ -280,7 +334,8 @@ def _ladder_call(qx, qy, dig1, dig2, rl, rnl, rnok, *, interpret=False,
 _CONSTS = _build_g_table()
 
 _ladder_jit = partial(
-    jax.jit, static_argnames=("interpret", "lanes", "fe_backend")
+    jax.jit,
+    static_argnames=("interpret", "lanes", "fe_backend", "carry_mode"),
 )(_ladder_call)
 
 
@@ -308,11 +363,15 @@ def verify_batch(
     interpret: bool = False,
     device=None,
     fe_backend: str = "vpu",
+    carry_mode: str = "lazy",
 ) -> np.ndarray:
     """Batched ECDSA verify on the Pallas path — same contract (and the
     same host prologue) as secp256k1_verify.verify_batch. `fe_backend`
-    selects the limb multiplier (fe_common.FE_BACKENDS); bit-exact."""
+    selects the limb multiplier (fe_common.FE_BACKENDS); `carry_mode`
+    "lazy" (default) defers limb carries between point ops, "eager" keeps
+    the per-op full carry ripple; verdicts are bit-exact either way."""
     fe_backend = _fc.normalize_backend(fe_backend)
+    carry_mode = _fc.normalize_carry_mode(carry_mode)
     n = len(pubkeys)
     if n == 0:
         return np.zeros((0,), dtype=bool)
@@ -348,11 +407,12 @@ def verify_batch(
     if interpret:
         ok = np.asarray(
             _ladder_call(*args, interpret=True, lanes=lanes,
-                         fe_backend=fe_backend)
+                         fe_backend=fe_backend, carry_mode=carry_mode)
         )[0, :n]
     else:
         ok = np.asarray(
-            _ladder_jit(*args, lanes=lanes, fe_backend=fe_backend)
+            _ladder_jit(*args, lanes=lanes, fe_backend=fe_backend,
+                        carry_mode=carry_mode)
         )[0, :n]
 
     f = forced[:n]

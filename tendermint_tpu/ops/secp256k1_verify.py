@@ -60,26 +60,11 @@ def limbs_to_int(limbs) -> int:
     return sum(int(v) << (BITS * i) for i, v in enumerate(np.asarray(limbs)))
 
 
-def _wide_zero(multiple: int) -> np.ndarray:
-    """Limbs of multiple·p with EVERY limb ≥ 2·MASK (so a + K − b never
-    underflows for carried a, b) and every limb < 2^31."""
-    v = multiple * P
-    limbs = [(v >> (BITS * i)) & MASK for i in range(22)]
-    limbs[NLIMB - 1] += limbs[NLIMB] << BITS  # collapse limbs 20/21 into 19
-    limbs[NLIMB - 1] += limbs[NLIMB + 1] << (2 * BITS)
-    limbs = limbs[:NLIMB]
-    for i in range(NLIMB - 1):
-        if limbs[i] < 2 * MASK:
-            t = ((2 * MASK - limbs[i]) >> BITS) + 1
-            limbs[i] += t << BITS
-            limbs[i + 1] -= t
-    arr = np.array(limbs, dtype=np.uint32)
-    assert limbs_to_int(arr) % P == 0
-    assert all(2 * MASK <= int(l) < (1 << 31) for l in arr), arr
-    return arr
-
-
-_K_SUB = _wide_zero(64)
+# Wide zero for fe_sub, derived in fe_common so its limbs provably
+# dominate the eager closed set (a hand-floored 2*MASK constant does not:
+# the wrap fold carries limb 0 past it — see fe_common._dominating_ksub)
+_K_SUB = np.asarray(_fc.SECP_KSUB_LIMBS, dtype=np.uint32)
+assert limbs_to_int(_K_SUB) % P == 0
 
 _GX_L = int_to_limbs(GX)
 _GY_L = int_to_limbs(GY)
@@ -126,6 +111,14 @@ def fe_sub(a, b):
 # int8 plane bound; see fe_common._columns_mxu_rows). Set exclusively by
 # _compiled_kernel's wrapper; the jit cache is keyed on it.
 _FE_BACKEND = "vpu"
+
+# Carry schedule for the ladder's pt_add chain — swapped trace-time via
+# fe_common.trace_with_modes exactly like _FE_BACKEND; the module-level
+# fe_mul/fe_add/fe_sub/fe_mul_small stay the eager ops regardless.
+_CARRY_MODE = "eager"
+
+_PLAN = _fc.derive_carry_plan("secp256k1")
+_KD_SUB = np.asarray(_PLAN.kd, dtype=np.uint32)
 
 
 def fe_mul(a, b):
@@ -178,6 +171,60 @@ def fe_mul_small(a, k: int):
     return fe_carry(a * jnp.uint32(k), rounds=4)
 
 
+# --- deferred-carry (lazy) ops: batch-leading twins of the Pallas row ops,
+# used by pt_add when _CARRY_MODE == "lazy".  Operand classes and round
+# counts come from fe_common.derive_carry_plan (certified at import).
+
+
+def _lazy_mul_cols(a, b):
+    if _FE_BACKEND != "vpu":
+        return _fc.mul_columns_batch(a, b, 2 * NLIMB + 1, split=8)
+    shape = jnp.broadcast_shapes(a.shape[:-1], b.shape[:-1])
+    prod = jnp.zeros(shape + (2 * NLIMB + 1,), dtype=jnp.uint32)
+    for i in range(NLIMB):
+        prod = prod.at[..., i : i + NLIMB].add(a[..., i : i + 1] * b)
+    return prod
+
+
+def _lazy_mul(a, b, wide, fix):
+    tmp = _fc.secp_fold_fused_batch(_lazy_mul_cols(a, b))
+    for _ in range(_PLAN.mid):
+        tmp = _fc.carry_drop_top_batch(tmp)
+    lo = _fc.secp_fold2_batch(tmp)
+    for _ in range(wide):
+        lo = _fc.wide_carry_batch(lo, _fc.SECP_WRAP)
+    return _fc.fix_batch(lo, fix)
+
+
+def fe_mul_f(a, b):
+    """Full lazy multiply — output lands in the certified class C."""
+    return _lazy_mul(a, b, _PLAN.mulf_wide, _PLAN.mulf_fix)
+
+
+def fe_mul_l(a, b):
+    """Lazy multiply whose output stays in class D."""
+    return _lazy_mul(a, b, _PLAN.mull_wide, _PLAN.mull_fix)
+
+
+def fe_norm1(raw):
+    """One wide round + fixups: raw limb sum -> class C."""
+    return _fc.fix_batch(_fc.wide_carry_batch(raw, _fc.SECP_WRAP),
+                         _PLAN.norm_fix)
+
+
+def fe_add_l(a, b):
+    return fe_norm1(a + b)
+
+
+def fe_sub_l(a, b):
+    # always against the class-D wide zero: dominates class-C operands too
+    return fe_norm1(a + _KD_SUB - b)
+
+
+def fe_mul_small_l(a, k: int):
+    return fe_norm1(a * jnp.uint32(k))
+
+
 def fe_inv(z):
     def body(acc, bit):
         acc = fe_sq(acc)
@@ -225,6 +272,26 @@ def fe_canonical(x):
 def pt_add(p, q):
     X1, Y1, Z1 = p
     X2, Y2, Z2 = q
+    if _CARRY_MODE == "lazy":
+        # deferred carries: coordinates stay in class C, the 12 operand
+        # products ride as class D between single-round norm1 folds; only
+        # the Z1·Z2 product (feeding fe_mul_small) runs the full schedule
+        t0 = fe_mul_l(X1, X2)
+        t1 = fe_mul_l(Y1, Y2)
+        t2 = fe_mul_f(Z1, Z2)
+        t3 = fe_sub_l(fe_mul_l(fe_add_l(X1, Y1), X2 + Y2), t0 + t1)
+        t4 = fe_sub_l(fe_mul_l(fe_add_l(Y1, Z1), Y2 + Z2), t1 + t2)
+        X3 = fe_mul_l(fe_add_l(X1, Z1), X2 + Z2)
+        Y3 = fe_sub_l(X3, t0 + t2)
+        t0x3 = fe_add_l(t0 + t0, t0)
+        t2b = fe_mul_small_l(t2, B3)
+        Z3 = fe_add_l(t1, t2b)
+        t1 = fe_sub_l(t1, t2b)
+        Y3b = fe_mul_small_l(Y3, B3)
+        X3 = fe_sub_l(fe_mul_l(t3, t1), fe_mul_l(t4, Y3b))
+        Y3 = fe_add_l(fe_mul_l(Y3b, t0x3), fe_mul_l(t1, Z3))
+        Z3 = fe_add_l(fe_mul_l(Z3, t4), fe_mul_l(t0x3, t3))
+        return X3, Y3, Z3
     t0 = fe_mul(X1, X2)
     t1 = fe_mul(Y1, Y2)
     t2 = fe_mul(Z1, Z2)
@@ -303,14 +370,16 @@ def _verify_kernel(qx, qy, u1_words, u2_words, r_limbs, rn_limbs, rn_ok):
 _kernel_cache: dict = {}
 
 
-def _compiled_kernel(batch: int, mesh=None, fe_backend: str = "vpu"):
+def _compiled_kernel(batch: int, mesh=None, fe_backend: str = "vpu",
+                     carry_mode: str = "eager"):
+    carry_mode = _fc.effective_carry_mode(fe_backend, carry_mode)
     if fe_backend not in ("vpu", "mxu"):
         fe_backend = "mxu" if fe_backend == "mxu16" else "vpu"
-    key = (batch, mesh, fe_backend)
+    key = (batch, mesh, fe_backend, carry_mode)
     fn = _kernel_cache.get(key)
     if fn is None:
-        kernel = _fc.trace_with_backend(
-            sys.modules[__name__], _verify_kernel, fe_backend
+        kernel = _fc.trace_with_modes(
+            sys.modules[__name__], _verify_kernel, fe_backend, carry_mode
         )
         if mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as PS
@@ -397,11 +466,15 @@ def verify_batch(
     sigs: Sequence[bytes],
     mesh=None,
     fe_backend: str = "vpu",
+    carry_mode: str = "lazy",
 ) -> np.ndarray:
     """Batched ECDSA verify; bit-exact with crypto/secp256k1.verify.
     pubkeys: 33-byte compressed; digests: 32 bytes; sigs: DER.
-    fe_backend: "vpu" | "mxu" limb multiplier ("mxu16" degrades to "mxu")."""
+    fe_backend: "vpu" | "mxu" limb multiplier ("mxu16" degrades to "mxu");
+    carry_mode "lazy" (default) defers limb carries between point ops,
+    "eager" keeps the full per-op ripple — verdicts are bit-exact both ways."""
     fe_backend = _fc.normalize_backend(fe_backend)
+    carry_mode = _fc.normalize_carry_mode(carry_mode)
     n = len(pubkeys)
     if n == 0:
         return np.zeros((0,), dtype=bool)
@@ -431,7 +504,7 @@ def verify_batch(
             rnl[i] = int_to_limbs(r + N)
             rn_ok[i] = True
 
-    kernel = _compiled_kernel(b, mesh, fe_backend)
+    kernel = _compiled_kernel(b, mesh, fe_backend, carry_mode)
     host = (qx, qy, u1w, u2w, rl, rnl, rn_ok)
     if mesh is not None:
         # device_put the *numpy* arrays straight onto the mesh sharding: an

@@ -5,7 +5,13 @@ Every fe op (mul / sq / add / sub / carry / inv) on every backend
 reference, over random limb vectors plus the adversarial patterns the
 ISSUE calls out: all-ones 13-bit limbs, p-1, p, p+1, and inputs held at
 the closed-set carried maxima (the largest limbs any op chain can
-produce).  Runs entirely eagerly under JAX_PLATFORMS=cpu — tier-1.
+produce).  Runs entirely eagerly under JAX_PLATFORMS=cpu.
+
+Two tiers: the default run keeps a fast core (edge-case lanes plus one
+random lane per pattern, inv on the vpu reference backend) under ~30s;
+the exhaustive sweeps — full random lane counts, inv on every backend
+including the eager mxu16 repack — carry `@pytest.mark.slow` and run
+with `-m slow`.
 
 The bounds section replaces the hand-stated overflow analysis that used
 to live in the ed25519_pallas header comment: fe_common.bound_*
@@ -55,7 +61,13 @@ def _ksub_col(curve):
     )
 
 
-def _inputs(curve, rng, n_random=6):
+# random lanes per adversarial pattern: the fast tier keeps one (edge
+# cases dominate the lane mix), the slow sweep restores the full count
+FAST_RANDOM = 1
+SLOW_RANDOM = 6
+
+
+def _inputs(curve, rng, n_random=SLOW_RANDOM):
     """Limb vectors spanning the whole legal input space: canonical
     values (random, 0, 1, p-1, p, p+1, 2^256-1), the all-ones fresh
     bound (every limb = MASK), and the closed-set carried maxima."""
@@ -82,7 +94,7 @@ class TestFeOpsVsBignum:
         p = CURVES[curve]["p"]
         fe = fc.make_fe(curve, backend)
         rng = np.random.default_rng(7)
-        cols = _inputs(curve, rng)
+        cols = _inputs(curve, rng, n_random=FAST_RANDOM)
         a = _lanes(cols)
         b = _lanes(cols[::-1])
         got = np.asarray(fe.mul(a, b))
@@ -101,7 +113,7 @@ class TestFeOpsVsBignum:
         p = CURVES[curve]["p"]
         fe = fc.make_fe(curve, backend)
         rng = np.random.default_rng(11)
-        cols = _inputs(curve, rng)
+        cols = _inputs(curve, rng, n_random=FAST_RANDOM)
         a = _lanes(cols)
         b = _lanes(cols[::-1])
         ksub = _ksub_col(curve)
@@ -118,16 +130,14 @@ class TestFeOpsVsBignum:
                 curve, backend, "carry", k)
 
     def test_inv(self, curve, backend):
-        import os
-
-        if backend == "mxu16" and not os.environ.get("TM_RUN_SLOW"):
-            # ~250 eager muls through the radix-2^16 repack is minutes on
-            # CPU; mul/sq/add/sub/carry still cover mxu16 in tier-1
-            pytest.skip("mxu16 inv is slow eagerly (set TM_RUN_SLOW=1)")
+        if backend != "vpu":
+            # ~250 eager muls per backend is the bulk of this file's
+            # runtime; mul/sq/add/sub/carry cover mxu/mxu16 in the fast
+            # tier, the exhaustive class sweeps inv on every backend
+            pytest.skip("non-vpu inv runs in the slow sweep (-m slow)")
         p = CURVES[curve]["p"]
         fe = fc.make_fe(curve, backend)
-        rng = np.random.default_rng(13)
-        vals = [1, 2, p - 1, int(rng.integers(2, 1 << 61)) ** 4 % p]
+        vals = [1, 2, p - 1]
         cols = [to_limbs(v) for v in vals]
         got = np.asarray(fe.inv(_lanes(cols)))
         for k, v in enumerate(vals):
@@ -144,6 +154,62 @@ class TestFeOpsVsBignum:
         got = np.asarray(fe.mul_small(_lanes(cols), 21))
         for k, c in enumerate(cols):
             assert from_limbs(got[:, k]) % p == (from_limbs(c) * 21) % p
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("curve", list(CURVES))
+@pytest.mark.parametrize("backend", fc.FE_BACKENDS)
+class TestFeOpsVsBignumExhaustive:
+    """The full-width sweeps the fast tier trims: every adversarial
+    pattern with the full random lane count, and inv on every backend
+    (including the eager mxu16 repack — minutes on CPU)."""
+
+    def test_mul_sq_exhaustive(self, curve, backend):
+        p = CURVES[curve]["p"]
+        fe = fc.make_fe(curve, backend)
+        rng = np.random.default_rng(7)
+        cols = _inputs(curve, rng, n_random=SLOW_RANDOM)
+        a = _lanes(cols)
+        b = _lanes(cols[::-1])
+        got = np.asarray(fe.mul(a, b))
+        sq = np.asarray(fe.sq(a))
+        for k in range(a.shape[1]):
+            va, vb = from_limbs(cols[k]), from_limbs(cols[::-1][k])
+            assert from_limbs(got[:, k]) % p == (va * vb) % p, (
+                curve, backend, "mul", k)
+            assert from_limbs(sq[:, k]) % p == (va * va) % p, (
+                curve, backend, "sq", k)
+
+    def test_add_sub_carry_exhaustive(self, curve, backend):
+        p = CURVES[curve]["p"]
+        fe = fc.make_fe(curve, backend)
+        rng = np.random.default_rng(11)
+        cols = _inputs(curve, rng, n_random=SLOW_RANDOM)
+        a = _lanes(cols)
+        b = _lanes(cols[::-1])
+        ksub = _ksub_col(curve)
+        got_add = np.asarray(fe.add(a, b))
+        got_sub = np.asarray(fe.sub(a, b, ksub))
+        got_carry = np.asarray(fe.carry(a))
+        for k in range(a.shape[1]):
+            va, vb = from_limbs(cols[k]), from_limbs(cols[::-1][k])
+            assert from_limbs(got_add[:, k]) % p == (va + vb) % p, (
+                curve, backend, "add", k)
+            assert from_limbs(got_sub[:, k]) % p == (va - vb) % p, (
+                curve, backend, "sub", k)
+            assert from_limbs(got_carry[:, k]) % p == va % p, (
+                curve, backend, "carry", k)
+
+    def test_inv_all_backends(self, curve, backend):
+        p = CURVES[curve]["p"]
+        fe = fc.make_fe(curve, backend)
+        rng = np.random.default_rng(13)
+        vals = [1, 2, p - 1, int(rng.integers(2, 1 << 61)) ** 4 % p]
+        cols = [to_limbs(v) for v in vals]
+        got = np.asarray(fe.inv(_lanes(cols)))
+        for k, v in enumerate(vals):
+            assert from_limbs(got[:, k]) % p == pow(v, p - 2, p), (
+                curve, backend, "inv", k)
 
 
 class TestBatchLayout:
@@ -175,20 +241,24 @@ class TestBatchLayout:
             np.testing.assert_array_equal(got & 0xFFFFFFFF,
                                           want & 0xFFFFFFFF)
 
-    def test_constant_operand_broadcasts(self, curve="ed25519"):
+    @pytest.mark.parametrize(
+        "backend",
+        ["vpu", "mxu",
+         pytest.param("mxu16", marks=pytest.mark.slow)])
+    def test_constant_operand_broadcasts(self, backend, curve="ed25519"):
         # pt_add multiplies by (NLIMB, 1) constants (d2, ksub); the MXU
-        # path must broadcast them against (NLIMB, B) like the VPU does
+        # path must broadcast them against (NLIMB, B) like the VPU does.
+        # The eager mxu16 repack is the slow one — slow tier only.
         p = CURVES[curve]["p"]
         rng = np.random.default_rng(23)
         a = rng.integers(0, MASK + 1, (NLIMB, 5)).astype(np.uint32)
         c = rng.integers(0, MASK + 1, (NLIMB, 1)).astype(np.uint32)
-        for backend in fc.FE_BACKENDS:
-            fe = fc.make_fe(curve, backend)
-            got = np.asarray(fe.mul(jnp.asarray(a), jnp.asarray(c)))
-            vc = from_limbs(c[:, 0])
-            for k in range(a.shape[1]):
-                assert from_limbs(got[:, k]) % p == (
-                    from_limbs(a[:, k]) * vc) % p, (backend, k)
+        fe = fc.make_fe(curve, backend)
+        got = np.asarray(fe.mul(jnp.asarray(a), jnp.asarray(c)))
+        vc = from_limbs(c[:, 0])
+        for k in range(a.shape[1]):
+            assert from_limbs(got[:, k]) % p == (
+                from_limbs(a[:, k]) * vc) % p, (backend, k)
 
 
 class TestXlaKernelFeMul:
