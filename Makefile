@@ -4,7 +4,7 @@ PYTHON ?= python
 
 .PHONY: test test_slow test_sanitizers bench bench-local bench_fastsync \
         planner-bench pallas-bench bench_secp bench_multisig mempool-bench \
-        metrics-lint bench-check statesync-smoke flight-smoke chaos-smoke \
+        lite-bench metrics-lint bench-check statesync-smoke flight-smoke chaos-smoke \
         localnet-start localnet-stop build-docker-localnode
 
 test:
@@ -58,6 +58,10 @@ bench_multisig:
 # recheck throughput; headline metric is mempool_checktx_per_s
 mempool-bench:
 	JAX_PLATFORMS=cpu $(PYTHON) scripts/bench_mempool.py $(ARGS)
+
+# multi-client light-client frontend vs per-client serial verification
+lite-bench:
+	JAX_PLATFORMS=cpu $(PYTHON) scripts/bench_lite.py $(ARGS)
 
 # strict text-format v0.0.4 self-check of Registry.expose_text(); pass files
 # to lint scrape snapshots: make metrics-lint ARGS="/tmp/m.prom"

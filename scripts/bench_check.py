@@ -46,6 +46,8 @@ DEFAULT_METRICS = [
     # gate: the windowed point ops are where the deferred-carry pool
     # lives, so a lazy-carry regression moves this slope first
     "pallas_ladder_window_slope:0.25:lower",
+    # light-client frontend headline (scripts/bench_lite.py / make lite-bench)
+    "lite_frontend_headers_per_s:0.25:higher",
 ]
 DEFAULT_THRESHOLD = 0.20
 

@@ -1,0 +1,201 @@
+"""Light-client frontend bench: batched multi-client serving vs per-client
+serial DynamicVerifier loops.
+
+Builds a churny signed chain (valset changes force bisection), then serves
+N concurrent clients two ways:
+
+  * serial:  every client owns a DynamicVerifier + trust store and verifies
+    its target headers itself — N times the bisection and signature work;
+  * batched: every client goes through ONE LiteFrontend — per-height work
+    is single-flighted, verified headers are cached, and the signature
+    batches of concurrent certifications fold into shared planner lanes.
+
+Emits one JSON line per stage and a final combined JSON line (the bench
+ledger keeps the last line; `make bench-check` gates
+``lite_frontend_headers_per_s``).  Cache hit ratio and aggregator lane
+occupancy ride in the headline line.
+
+Usage: python scripts/bench_lite.py [n_clients] [n_heights] [--metrics-out P]
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from _bench_metrics import pop_metrics_out
+
+N_CLIENTS = int(sys.argv[1]) if len(sys.argv) > 1 else 64
+N_HEIGHTS = int(sys.argv[2]) if len(sys.argv) > 2 else 14
+TARGET_WINDOW = 4  # each client certifies the last TARGET_WINDOW heights
+
+
+def _build_fixture():
+    from tendermint_tpu.abci.examples.kvstore import PersistentKVStoreApp
+    from tendermint_tpu.crypto.keys import PrivKeyEd25519
+    from tendermint_tpu.testutil.chain import build_chain
+    from tendermint_tpu.types import MockPV
+
+    joiners = [
+        MockPV(PrivKeyEd25519.generate(bytes([120 + i]) * 32))
+        for i in range(3)
+    ]
+
+    def val_tx(pv, power):
+        return (
+            b"val:" + base64.b64encode(pv.get_pub_key().bytes())
+            + b"!%d" % power
+        )
+
+    def on_height(h, st):
+        if h == 4:
+            return [val_tx(pv, 100) for pv in joiners]
+        if h == 8:
+            leavers = [
+                v for v in st.validators.validators if v.voting_power == 10
+            ][:3]
+            return [
+                b"val:" + base64.b64encode(v.pub_key.bytes()) + b"!0"
+                for v in leavers
+            ]
+        return []
+
+    return build_chain(
+        n_vals=4,
+        n_heights=max(N_HEIGHTS, TARGET_WINDOW + 2),
+        chain_id="lite-bench",
+        app_factory=PersistentKVStoreApp,
+        on_height=on_height,
+        extra_pvs=joiners,
+    )
+
+
+def _run_clients(n, work):
+    """Run `work(client_idx)` on n concurrent threads; wall seconds."""
+    errs = []
+
+    def runner(i):
+        try:
+            work(i)
+        except Exception as e:  # pragma: no cover - surfaces in the ledger
+            errs.append(repr(e))
+
+    threads = [threading.Thread(target=runner, args=(i,)) for i in range(n)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    dt = time.perf_counter() - t0
+    if errs:
+        raise RuntimeError(f"{len(errs)} clients failed: {errs[0]}")
+    return dt
+
+
+def main() -> int:
+    metrics_out = pop_metrics_out()
+    from tendermint_tpu.frontend import LiteFrontend
+    from tendermint_tpu.libs.db.kv import MemDB
+    from tendermint_tpu.libs.metrics import FrontendMetrics
+    from tendermint_tpu.lite.provider import DBProvider, NodeProvider
+    from tendermint_tpu.lite.verifier import DynamicVerifier
+
+    fx = _build_fixture()
+    src = NodeProvider(fx.block_store, fx.state_db)
+    targets = list(range(fx.height - TARGET_WINDOW + 1, fx.height + 1))
+    headers_total = N_CLIENTS * len(targets)
+    seed_fc = src.full_commit_at(fx.chain_id, 1)
+    want = {
+        h: src.full_commit_at(fx.chain_id, h).marshal() for h in targets
+    }
+    print(json.dumps({
+        "stage": "fixture", "clients": N_CLIENTS, "chain_height": fx.height,
+        "targets": targets,
+    }), flush=True)
+
+    # -- serial: per-client DynamicVerifier, own trust store ---------------
+    def serial_client(i):
+        dv = DynamicVerifier(fx.chain_id, DBProvider(MemDB()), src)
+        dv.init_from_full_commit(seed_fc)
+        for h in targets:
+            dv.verify(src.full_commit_at(fx.chain_id, h).signed_header)
+
+    serial_s = _run_clients(N_CLIENTS, serial_client)
+    serial_rate = headers_total / serial_s
+    print(json.dumps({
+        "stage": "serial", "headers_per_s": round(serial_rate, 1),
+        "seconds": round(serial_s, 3),
+    }), flush=True)
+
+    # -- batched: one shared LiteFrontend ----------------------------------
+    metrics = FrontendMetrics()
+    fe = LiteFrontend(
+        fx.chain_id, src, use_device=False, batch_window_s=0.002,
+        metrics=metrics,
+    )
+    fe.init_trust(seed_fc)
+    got = {}
+    got_mtx = threading.Lock()
+
+    def batched_client(i):
+        # rotate per client so the population spreads over the window
+        # (lockstep clients would only ever miss-then-wait, never hit)
+        k = i % len(targets)
+        for h in targets[k:] + targets[:k]:
+            fc = fe.certified_commit(h)
+            with got_mtx:
+                got.setdefault(h, fc.marshal())
+
+    batched_s = _run_clients(N_CLIENTS, batched_client)
+    batched_rate = headers_total / batched_s
+    stats = fe.stats()
+    fe.close()
+
+    # verdict parity: the batched path certified byte-identical FullCommits
+    parity = all(got.get(h) == want[h] for h in targets)
+
+    ev = metrics.cache_events._values
+    hits = ev.get(("hit",), 0.0)
+    misses = ev.get(("miss",), 0.0)
+    waits = ev.get(("wait",), 0.0)
+    lookups = hits + misses + waits
+    hit_ratio = hits / lookups if lookups else 0.0
+    print(json.dumps({
+        "stage": "batched", "headers_per_s": round(batched_rate, 1),
+        "seconds": round(batched_s, 3),
+        "cache_hit_ratio": round(hit_ratio, 4),
+        "dispatches": stats["dispatches"],
+        "avg_batch_rows": round(stats["avg_batch_rows"], 2),
+        "avg_occupancy": round(stats["avg_occupancy"], 4),
+    }), flush=True)
+
+    if metrics_out:
+        with open(metrics_out, "w") as f:
+            f.write(metrics.registry.expose_text())
+        print(f"# metrics snapshot -> {metrics_out}", file=sys.stderr)
+
+    # headline last: the ledger's parser keeps the final JSON line
+    print(json.dumps({
+        "metric": "lite_frontend_headers_per_s",
+        "value": round(batched_rate, 1),
+        "unit": "headers/s",
+        "lite_frontend_headers_per_s": round(batched_rate, 1),
+        "lite_serial_headers_per_s": round(serial_rate, 1),
+        "vs_serial": round(batched_rate / serial_rate, 2),
+        "clients": N_CLIENTS,
+        "headers": headers_total,
+        "cache_hit_ratio": round(hit_ratio, 4),
+        "lane_occupancy": round(stats["avg_occupancy"], 4),
+        "parity": parity,
+    }), flush=True)
+    return 0 if parity else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

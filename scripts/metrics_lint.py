@@ -273,6 +273,7 @@ def lint_text(text):
 def _self_check():
     """Exercise labeled histograms and every escaping edge, then lint."""
     from tendermint_tpu.libs.metrics import (
+        FrontendMetrics,
         NodeMetrics,
         Registry,
         VerifyMetrics,
@@ -308,6 +309,18 @@ def _self_check():
     vm.device_retries.add(1.0)
     vm.device_audit.add(8.0, ("ok",))
     vm.device_audit.add(1.0, ("mismatch",))
+
+    fm = FrontendMetrics()
+    fm.requests.add(3.0, ("verify_commit", "ok"))
+    fm.requests.add(1.0, ("light_block", "error"))
+    fm.cache_events.add(5.0, ("hit",))
+    fm.cache_events.add(1.0, ("miss",))
+    fm.cache_events.add(2.0, ("wait",))
+    fm.cache_size.set(4.0)
+    fm.heights_verified.add(2.0)
+    fm.batch_rows.observe(8.0)
+    fm.batch_occupancy.observe(0.75)
+    fm.verify_seconds.observe(0.004)
 
     nm = NodeMetrics()
     # exercise the hot-path families so the lint covers sample lines, not
@@ -378,9 +391,32 @@ def _self_check():
             ("device-family parity",
              [f"missing family {n}" for n in missing_dev])
         )
+    # light-client frontend family parity: FrontendMetrics owns the names,
+    # NodeMetrics attaches the frontend registry into /metrics
+    frontend_names = (
+        "tendermint_lite_frontend_requests_total",
+        "tendermint_lite_frontend_cache_events_total",
+        "tendermint_lite_frontend_cache_size",
+        "tendermint_lite_frontend_heights_verified_total",
+        "tendermint_lite_frontend_batch_rows",
+        "tendermint_lite_frontend_batch_occupancy",
+        "tendermint_lite_frontend_verify_seconds",
+    )
+    frontend_text = fm.registry.expose_text()
+    missing_fe = [
+        n for n in frontend_names
+        if f"# TYPE {n} " not in frontend_text
+        or f"# TYPE {n} " not in node_text
+    ]
+    if missing_fe:
+        failures.append(
+            ("frontend-family parity",
+             [f"missing family {n}" for n in missing_fe])
+        )
     for label, text in (
         ("escaping registry", r.expose_text()),
         ("VerifyMetrics", vm.registry.expose_text()),
+        ("FrontendMetrics", frontend_text),
         ("NodeMetrics(+verify attached)", node_text),
     ):
         errs = lint_text(text)

@@ -215,17 +215,20 @@ class PersistentKVStoreApp(KVStoreApp):
     # -- state-sync snapshots ------------------------------------------------
     def configure_snapshots(
         self, store, interval: int, chunk_size: int = 65536,
-        keep_recent: int = 3,
+        keep_recent: int = 3, snapshot_format: int = 1,
     ) -> None:
         """Enable snapshot production: every `interval` heights, chunk the
         persisted state blob into `store` (a statesync.SnapshotStore).
         Chunking and store writes happen on a daemon worker thread;
         commit() only enqueues the (height, blob) pair — see ROADMAP
-        "snapshot production is synchronous in commit()"."""
+        "snapshot production is synchronous in commit()".
+        `snapshot_format` picks the wire format (chunker.SUPPORTED_FORMATS;
+        2 = per-chunk zlib)."""
         self._snapshot_store = store
         self._snapshot_interval = interval
         self._snapshot_chunk_size = chunk_size
         self._snapshot_keep_recent = keep_recent
+        self._snapshot_format = snapshot_format
         if self._snap_thread is None:
             self._snap_queue = queue.Queue()
             self._snap_thread = threading.Thread(
@@ -245,9 +248,18 @@ class PersistentKVStoreApp(KVStoreApp):
                     "statesync.snapshot_produce", height=height,
                     size=len(blob),
                 ):
-                    snap, chunks = chunker.make_snapshot(
-                        height, blob, self._snapshot_chunk_size
-                    )
+                    fmt = getattr(self, "_snapshot_format", 1)
+                    if fmt != 1:
+                        snap, chunks = chunker.make_snapshot(
+                            height, blob, self._snapshot_chunk_size,
+                            format=fmt,
+                        )
+                    else:
+                        # format 1 keeps the 3-arg call shape (tests stub
+                        # make_snapshot with exactly this signature)
+                        snap, chunks = chunker.make_snapshot(
+                            height, blob, self._snapshot_chunk_size
+                        )
                     self._snapshot_store.save(snap, chunks)
                     self._snapshot_store.prune(self._snapshot_keep_recent)
             except Exception:
@@ -294,14 +306,14 @@ class PersistentKVStoreApp(KVStoreApp):
         self, req: abci.RequestOfferSnapshot
     ) -> abci.ResponseOfferSnapshot:
         from tendermint_tpu.statesync.chunker import (
-            SNAPSHOT_FORMAT,
+            SUPPORTED_FORMATS,
             chunk_hashes_from_metadata,
         )
 
         snap = req.snapshot
         if snap is None or snap.height <= 0:
             return abci.ResponseOfferSnapshot(result=abci.OFFER_SNAPSHOT_REJECT)
-        if snap.format != SNAPSHOT_FORMAT:
+        if snap.format not in SUPPORTED_FORMATS:
             return abci.ResponseOfferSnapshot(
                 result=abci.OFFER_SNAPSHOT_REJECT_FORMAT
             )
@@ -348,9 +360,18 @@ class PersistentKVStoreApp(KVStoreApp):
             return abci.ResponseApplySnapshotChunk(
                 result=abci.APPLY_CHUNK_ACCEPT
             )
-        # last chunk: swap in the restored state
-        blob = b"".join(chunks)
+        # last chunk: decode per the negotiated wire format, then swap in
+        # the restored state (the manifest covered the wire bytes, so a
+        # chunk that fails to decode means the producer was corrupt)
+        from tendermint_tpu.statesync.chunker import decode_chunk
+
         self._restoring = None
+        try:
+            blob = b"".join(decode_chunk(c, snap.format) for c in chunks)
+        except ValueError:
+            return abci.ResponseApplySnapshotChunk(
+                result=abci.APPLY_CHUNK_REJECT_SNAPSHOT
+            )
         try:
             obj = json.loads(blob.decode())
             _ = (obj["height"], obj["size"], obj["kv"], obj["vals"])

@@ -192,6 +192,11 @@ class StateSyncConfig:
     snapshot_interval: int = 0  # take a snapshot every N heights; 0 = off
     snapshot_chunk_size: int = 65536
     snapshot_keep_recent: int = 3
+    # wire format for produced snapshots: 1 = raw chunks (reference),
+    # 2 = per-chunk zlib (statesync/chunker.py SNAPSHOT_FORMAT_ZLIB).
+    # Restoring nodes negotiate: an app that rejects a format with
+    # REJECT_FORMAT makes the syncer retry the next advertised format.
+    snapshot_format: int = 1
 
 
 @dataclass
@@ -223,6 +228,32 @@ class VerifyConfig:
     # audit/breaker machinery cross-checks them like any device backend.
     # TM_FE_BACKEND env overrides.
     fe_backend: str = "vpu"
+
+
+@dataclass
+class FrontendConfig:
+    """[frontend] — the multi-client light-client serving frontend
+    (frontend/ package).  When enabled the node runs a `LiteFrontend`
+    over its own block store (NodeProvider source) and, if `laddr` is
+    set, serves the lite-proxy HTTP surface (/verify_commit,
+    /light_block, ...) from it."""
+
+    enable: bool = False
+    # listen address for the HTTP surface, host:port; "" = frontend is
+    # built (RPC frontend_status works) but no socket is opened
+    laddr: str = ""
+    # aggregation window: how long a flush waits for more client rows
+    batch_window_s: float = 0.002
+    # rows per planner dispatch (one row = one commit's signature batch)
+    batch_max_rows: int = 64
+    # verified-header LRU entries
+    cache_size: int = 4096
+    # run batched dispatches on the accelerator (subject to [verify]
+    # breaker/guard); False = host path
+    use_device: bool = False
+    # optional social-consensus trust pin; 0/"" = trust-on-first-use
+    trusted_height: int = 0
+    trusted_hash: str = ""
 
 
 @dataclass
@@ -258,6 +289,7 @@ class Config:
     consensus: ConsensusConfig = field(default_factory=ConsensusConfig)
     statesync: StateSyncConfig = field(default_factory=StateSyncConfig)
     verify: VerifyConfig = field(default_factory=VerifyConfig)
+    frontend: FrontendConfig = field(default_factory=FrontendConfig)
     tx_index: TxIndexConfig = field(default_factory=TxIndexConfig)
     instrumentation: InstrumentationConfig = field(default_factory=InstrumentationConfig)
 

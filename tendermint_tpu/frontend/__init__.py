@@ -1,0 +1,20 @@
+"""Light-client verification frontend.
+
+A horizontally scalable read path for the `lite/` verifier: one process
+anchors any number of thin clients, folding their concurrent bisection /
+commit-verify requests into shared `parallel/planner` lane dispatches,
+deduplicating per-height verification work (cache + single-flight), and
+serving the result over the `lite/proxy` HTTP surface.  See README
+"Light-client frontend" for the architecture sketch.
+"""
+
+from tendermint_tpu.frontend.aggregator import BatchingVerifier
+from tendermint_tpu.frontend.cache import HeaderCache, SingleFlight
+from tendermint_tpu.frontend.frontend import LiteFrontend
+
+__all__ = [
+    "BatchingVerifier",
+    "HeaderCache",
+    "LiteFrontend",
+    "SingleFlight",
+]

@@ -1,0 +1,102 @@
+"""Verified-header cache: height-keyed LRU with valset-hash pinning, plus
+the single-flight primitive the frontend dedups concurrent misses with.
+
+Entries are *certified* FullCommits — their commit verified by their own
+validator set through the frontend's batched path.  That fact is
+client-independent, so every client bisecting the same chain shares it.
+The pin is the validators hash the entry was certified under: a lookup
+that expects a different hash is a miss, so a provider equivocating
+between fetches can never turn the cache into a confusion oracle.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Callable, Optional
+
+
+class HeaderCache:
+    """Height-keyed LRU of (FullCommit, valset-hash pin) entries."""
+
+    def __init__(self, capacity: int = 4096):
+        self.capacity = max(1, int(capacity))
+        self._mtx = threading.Lock()
+        self._entries: "OrderedDict[int, tuple]" = OrderedDict()
+
+    def __len__(self) -> int:
+        with self._mtx:
+            return len(self._entries)
+
+    def get(self, height: int, pin: Optional[bytes] = None):
+        """The cached FullCommit at `height`, or None.  With `pin`, an
+        entry certified under a different validators hash is a miss."""
+        with self._mtx:
+            ent = self._entries.get(height)
+            if ent is None:
+                return None
+            fc, ent_pin = ent
+            if pin is not None and pin != ent_pin:
+                return None
+            self._entries.move_to_end(height)
+            return fc
+
+    def put(self, height: int, fc, pin: bytes) -> None:
+        with self._mtx:
+            self._entries[height] = (fc, pin)
+            self._entries.move_to_end(height)
+            while len(self._entries) > self.capacity:
+                self._entries.popitem(last=False)
+
+    def clear(self) -> None:
+        with self._mtx:
+            self._entries.clear()
+
+
+class SingleFlight:
+    """Per-key in-flight dedup: the first caller for a key becomes the
+    leader and runs the work; concurrent callers for the same key park
+    until the leader resolves, then share its result (or re-raise its
+    exception).  The key retires on completion, so a later request
+    retries fresh — failures are never cached."""
+
+    class _Flight:
+        __slots__ = ("ev", "result", "err")
+
+        def __init__(self):
+            self.ev = threading.Event()
+            self.result = None
+            self.err: Optional[BaseException] = None
+
+    def __init__(self):
+        self._mtx = threading.Lock()
+        self._flights: dict = {}
+
+    def do(self, key, fn: Callable, on_wait: Optional[Callable] = None):
+        """Run `fn` once per concurrent burst of `key`; `on_wait` fires on
+        the non-leader paths (the frontend's cache "wait" counter)."""
+        with self._mtx:
+            flight = self._flights.get(key)
+            if flight is None:
+                flight = self._Flight()
+                self._flights[key] = flight
+                leader = True
+            else:
+                leader = False
+        if not leader:
+            if on_wait is not None:
+                on_wait()
+            flight.ev.wait()
+            if flight.err is not None:
+                raise flight.err
+            return flight.result
+        try:
+            flight.result = fn()
+            return flight.result
+        except BaseException as e:
+            flight.err = e
+            raise
+        finally:
+            with self._mtx:
+                self._flights.pop(key, None)
+            flight.ev.set()

@@ -498,6 +498,62 @@ def get_statesync_metrics() -> StateSyncMetrics:
         return _statesync_metrics
 
 
+class FrontendMetrics:
+    """Light-client frontend telemetry (frontend/): request outcomes per
+    route, verified-header cache effectiveness, aggregator batch shape, and
+    end-to-end certification latency.  Process-wide like VerifyMetrics —
+    one frontend serves every client of the process."""
+
+    def __init__(self, registry: Optional[Registry] = None):
+        r = registry or Registry()
+        self.registry = r
+        self.requests = r.counter(
+            "lite_frontend_requests_total",
+            "Frontend requests by route and outcome (ok|error)",
+            label_names=("route", "outcome"),
+        )
+        self.cache_events = r.counter(
+            "lite_frontend_cache_events_total",
+            "Verified-header cache lookups by outcome (hit|miss|wait)",
+            label_names=("outcome",),
+        )
+        self.cache_size = r.gauge(
+            "lite_frontend_cache_size", "Verified headers currently cached"
+        )
+        self.heights_verified = r.counter(
+            "lite_frontend_heights_verified_total",
+            "Trust-extension operations actually performed — cache +"
+            " single-flight keep this well below requests under fan-in",
+        )
+        self.batch_rows = r.histogram(
+            "lite_frontend_batch_rows",
+            "Commit rows folded into one aggregated planner dispatch",
+            buckets=_SIZE_BUCKETS,
+        )
+        self.batch_occupancy = r.histogram(
+            "lite_frontend_batch_occupancy",
+            "Lane occupancy (present/dispatched) of aggregated dispatches",
+            buckets=(0.05, 0.1, 0.25, 0.5, 0.75, 0.9, 1.0),
+        )
+        self.verify_seconds = r.histogram(
+            "lite_frontend_verify_seconds",
+            "End-to-end certification latency per frontend request",
+        )
+
+
+_frontend_mtx = threading.Lock()
+_frontend_metrics: Optional[FrontendMetrics] = None
+
+
+def get_frontend_metrics() -> FrontendMetrics:
+    """Process-wide FrontendMetrics singleton (mirrors get_verify_metrics)."""
+    global _frontend_metrics
+    with _frontend_mtx:
+        if _frontend_metrics is None:
+            _frontend_metrics = FrontendMetrics()
+        return _frontend_metrics
+
+
 class NodeMetrics:
     """All four reference metric families on one registry
     (consensus/metrics.go:14, p2p/metrics.go, mempool/metrics.go,
@@ -650,6 +706,8 @@ class NodeMetrics:
         r.attach(self.verify.registry)
         self.statesync = get_statesync_metrics()
         r.attach(self.statesync.registry)
+        self.frontend = get_frontend_metrics()
+        r.attach(self.frontend.registry)
         self._last_block_time: Optional[float] = None
         # cardinality hygiene: at most MAX_PEER_LABELS distinct peer ids ever
         # get their own label value; the rest collapse into "overflow"

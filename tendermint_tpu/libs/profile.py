@@ -16,6 +16,12 @@ Callers that know which heights a window covers annotate the current thread
 with ``window(height_base)`` so entries can be grouped into a per-height
 ledger (`ledger()`), queryable via the unsafe-gated ``dump_profile`` RPC.
 
+Entry ``kind`` names the dispatch site: ``"device"`` / ``"host"`` from the
+planner's execute paths, and ``"frontend.verify_batch"`` for flushes of the
+light-client frontend's cross-client aggregator (`parallel/planner.py
+LaneFeed` as wired by `frontend/frontend.py`) — there ``heights`` counts
+the client rows folded into the flush, not consecutive block heights.
+
 Like libs/trace.py this is deliberately dependency-free and cheap when
 idle: recording is a dict append under a lock, and the ring buffer bounds
 memory no matter how long the node runs.

@@ -1,42 +1,85 @@
 """Light-client verifying proxy (ref: lite/proxy/proxy.go, wrapper.go and
 the `lite` CLI command, cmd/tendermint/commands/lite.go).
 
-``RPCProvider`` feeds the DynamicVerifier FullCommits fetched from an
-UNTRUSTED full node over RPC (codec-exact bytes via /lite_full_commit).
-``run_lite_proxy`` serves a local HTTP endpoint whose /commit and /status
-responses are only ever derived from headers the verifier certified —
-a caller of the proxy needs no trust in the backing node.
+``RPCProvider`` feeds the verifier FullCommits fetched from an UNTRUSTED
+full node over RPC (codec-exact bytes via /lite_full_commit), with request
+timeouts and bounded retry — a hung upstream surfaces as ``ProviderError``
+so the frontend sheds load instead of queueing behind a dead socket.
+
+``LiteProxy`` is the multi-client server: certification is delegated to a
+shared ``frontend.LiteFrontend`` (verified-header cache, single-flight
+dedup, cross-client lane aggregation), replacing the old per-instance
+``DynamicVerifier`` loop.  ``run_lite_proxy`` serves /status, /commit,
+/verify_commit and /light_block whose responses are only ever derived
+from headers the frontend certified — a caller needs no trust in the
+backing node.  A full node can pass its own ``block_store``/``state_db``
+(the ``NodeProvider`` path) and serve light clients without an RPC hop.
 """
 
 from __future__ import annotations
 
 import base64
+import http.client
 import json
-import threading
+import socket
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 from urllib.parse import parse_qs, urlparse
 
 from tendermint_tpu.encoding.codec import Reader
 from tendermint_tpu.libs.db.kv import new_db
-from tendermint_tpu.lite.provider import DBProvider, Provider, ProviderError
+from tendermint_tpu.lite.provider import (
+    DBProvider,
+    NodeProvider,
+    Provider,
+    ProviderError,
+)
 from tendermint_tpu.lite.types import FullCommit, LiteError, SignedHeader
-from tendermint_tpu.lite.verifier import DynamicVerifier
 from tendermint_tpu.rpc.client import HTTPClient, RPCClientError
 from tendermint_tpu.types.block import Commit, Header
 from tendermint_tpu.types.validator_set import ValidatorSet
 
+# transport-level failures worth a bounded retry; an RPC-level error
+# (RPCClientError) is the upstream *answering* "no" and never retried
+_TRANSIENT = (OSError, socket.timeout, http.client.HTTPException)
+
 
 class RPCProvider(Provider):
-    """Source provider over an untrusted node's RPC (lite/client/provider.go)."""
+    """Source provider over an untrusted node's RPC (lite/client/provider.go).
 
-    def __init__(self, addr: str):
-        self._client = HTTPClient(addr)
+    Every upstream call is bounded: `timeout` seconds per attempt, at most
+    `retries` retries (with linear backoff) on transport failures.  The
+    old behavior — an HTTPClient with no explicit deadline discipline and
+    no retry — let one hung upstream socket park a proxy worker thread
+    forever."""
+
+    def __init__(self, addr: str, timeout: float = 5.0, retries: int = 2,
+                 backoff: float = 0.05):
+        self._client = HTTPClient(addr, timeout=timeout)
+        self.retries = max(0, int(retries))
+        self.backoff = max(0.0, float(backoff))
+
+    def _call(self, what: str, fn):
+        last: Optional[Exception] = None
+        for attempt in range(self.retries + 1):
+            try:
+                return fn()
+            except RPCClientError as e:
+                raise ProviderError(f"{what}: {e}") from e
+            except _TRANSIENT as e:
+                last = e
+                if attempt < self.retries:
+                    time.sleep(self.backoff * (attempt + 1))
+        raise ProviderError(
+            f"{what}: upstream unreachable after {self.retries + 1} "
+            f"attempts: {last}"
+        ) from last
 
     def latest_full_commit(
         self, chain_id: str, min_height: int, max_height: int
     ) -> FullCommit:
-        status = self._client.status()
+        status = self._call("status", self._client.status)
         top = min(max_height, int(status["sync_info"]["latest_block_height"]))
         for h in range(top, min_height - 1, -1):
             try:
@@ -46,10 +89,10 @@ class RPCProvider(Provider):
         raise ProviderError(f"no full commit in [{min_height},{max_height}]")
 
     def full_commit_at(self, chain_id: str, height: int) -> FullCommit:
-        try:
-            raw = self._client.call("lite_full_commit", height=height)
-        except RPCClientError as e:
-            raise ProviderError(str(e)) from e
+        raw = self._call(
+            f"lite_full_commit({height})",
+            lambda: self._client.call("lite_full_commit", height=height),
+        )
         header = Header.decode(Reader(base64.b64decode(raw["header"])))
         commit = Commit.unmarshal(base64.b64decode(raw["commit"]))
         vals = ValidatorSet.unmarshal(base64.b64decode(raw["validators"]))
@@ -62,26 +105,65 @@ class RPCProvider(Provider):
 
 
 class LiteProxy:
-    """Certifies heights on demand and serves them (lite/proxy/proxy.go)."""
+    """Multi-client certification server (lite/proxy/proxy.go), backed by
+    the shared frontend: N concurrent callers of certified_commit share a
+    verified-header cache, per-height single-flight, and lane-aggregated
+    planner dispatches."""
 
     def __init__(
         self,
         chain_id: str,
-        node_addr: str,
+        node_addr: Optional[str] = None,
         trust_db=None,
         trusted_height: Optional[int] = None,
         trusted_hash: Optional[bytes] = None,
+        *,
+        block_store=None,
+        state_db=None,
+        source: Optional[Provider] = None,
+        provider_timeout: float = 5.0,
+        provider_retries: int = 2,
+        batch_window_s: float = 0.002,
+        batch_max_rows: int = 64,
+        cache_size: int = 4096,
+        mesh=None,
+        use_device: Optional[bool] = None,
     ):
         """trusted_height/trusted_hash: an explicit root of trust — the
         header hash the operator verified out of band. Without it, first
         run falls back to trust-on-first-use: the UNTRUSTED backing node's
         height-1 FullCommit defines the chain permanently (the trust DB
-        persists it), which a malicious first contact can exploit."""
+        persists it), which a malicious first contact can exploit.
+
+        Source resolution: an explicit `source` wins; else a full node's
+        own `block_store` + `state_db` serve in-proc (NodeProvider — no
+        RPC hop); else `node_addr` over RPC."""
+        from tendermint_tpu.frontend import LiteFrontend
+
         self.chain_id = chain_id
-        self.source = RPCProvider(node_addr)
-        self.trusted = DBProvider(trust_db if trust_db is not None else _memdb())
-        self.verifier = DynamicVerifier(chain_id, self.trusted, self.source)
-        self._client = HTTPClient(node_addr)
+        if source is not None:
+            self.source = source
+        elif block_store is not None and state_db is not None:
+            self.source = NodeProvider(block_store, state_db)
+        elif node_addr:
+            self.source = RPCProvider(
+                node_addr, timeout=provider_timeout, retries=provider_retries
+            )
+        else:
+            raise ValueError(
+                "need a source: node_addr, block_store+state_db, or source"
+            )
+        self.frontend = LiteFrontend(
+            chain_id,
+            self.source,
+            trust_db=trust_db,
+            mesh=mesh,
+            use_device=use_device,
+            batch_window_s=batch_window_s,
+            batch_max_rows=batch_max_rows,
+            cache_size=cache_size,
+        )
+        self.trusted = self.frontend.trusted  # the shared trust store
         if (trusted_height is None) != (trusted_hash is None):
             # height without hash would silently trust the untrusted node's
             # header at that height — the exact TOFU hole the pin exists to
@@ -96,11 +178,7 @@ class LiteProxy:
     def _ensure_seed(self) -> None:
         if self._seeded:
             return
-        store_has_chain = True
-        try:
-            self.trusted.latest_full_commit(self.chain_id, 1, 1 << 60)
-        except ProviderError:
-            store_has_chain = False
+        store_has_chain = self.frontend.has_trust()
 
         if store_has_chain:
             # the persistent store already has a chain: an explicit pin must
@@ -159,20 +237,20 @@ class LiteProxy:
                 "--trusted-hash) to pin a verified root of trust"
             )
             fc = self.source.full_commit_at(self.chain_id, 1)
-        self.verifier.init_from_full_commit(fc)
+        self.frontend.init_trust(fc)
         self._seeded = True
 
     def certified_commit(self, height: Optional[int] = None) -> FullCommit:
-        """FullCommit for `height` (default: node tip), verified."""
+        """FullCommit for `height` (default: source tip), verified through
+        the shared frontend."""
         self._ensure_seed()
         if height is None:
-            status = self._client.status()
-            height = int(status["sync_info"]["latest_block_height"])
+            tip = self.source.latest_full_commit(
+                self.chain_id, 1, 1 << 60
+            ).height
             # the tip's canonical commit may not be stored yet; step back
-            height = max(1, height - 1)
-        fc = self.source.full_commit_at(self.chain_id, height)
-        self.verifier.verify(fc.signed_header)
-        return fc
+            height = max(1, tip - 1)
+        return self.frontend.certified_commit(height)
 
     def status(self) -> dict:
         fc = self.certified_commit()
@@ -205,6 +283,36 @@ class LiteProxy:
             },
         }
 
+    def verify_commit(self, height: Optional[int] = None) -> dict:
+        """Certification verdict for `height`: block id, valset hash and
+        quorum facts a thin client can anchor on."""
+        fc = self.certified_commit(height)
+        h = fc.signed_header.header
+        return {
+            "verified": True,
+            "height": h.height,
+            "block_id_hash": fc.signed_header.commit.block_id.hash.hex().upper(),
+            "validators_hash": h.validators_hash.hex().upper(),
+            "next_validators_hash": h.next_validators_hash.hex().upper(),
+            "total_voting_power": fc.validators.total_voting_power(),
+        }
+
+    def light_block(self, height: Optional[int] = None) -> dict:
+        """Codec-exact certified FullCommit bytes (b64) — what a thin
+        client or restoring peer feeds straight into FullCommit.unmarshal."""
+        self._ensure_seed()
+        raw = self.frontend.light_block(height)
+        return {
+            "verified": True,
+            "full_commit": base64.b64encode(raw).decode(),
+        }
+
+    def stats(self) -> dict:
+        return self.frontend.stats()
+
+    def close(self) -> None:
+        self.frontend.close()
+
 
 def _memdb():
     from tendermint_tpu.libs.db.kv import MemDB
@@ -220,7 +328,9 @@ def run_lite_proxy(
     trusted_height: Optional[int] = None,
     trusted_hash: Optional[bytes] = None,
 ) -> int:
-    """Serve /status and /commit?height=N with verified-only data."""
+    """Serve /status, /commit, /verify_commit and /light_block (all
+    ?height=N) with verified-only data; concurrent requests batch through
+    the shared frontend."""
     import os
 
     trust_db = new_db("lite_trust", "sqlite", os.path.join(home, "data"))
@@ -228,6 +338,18 @@ def run_lite_proxy(
         chain_id, node_addr, trust_db,
         trusted_height=trusted_height, trusted_hash=trusted_hash,
     )
+    httpd = serve_proxy(proxy, laddr)
+    print(f"lite proxy verifying {node_addr} (chain {chain_id}) on {laddr}", flush=True)
+    try:
+        httpd.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+def serve_proxy(proxy: LiteProxy, laddr: str) -> ThreadingHTTPServer:
+    """Build the HTTP server for a LiteProxy (callers own serve_forever —
+    the node embeds this to serve its own block store)."""
 
     class Handler(BaseHTTPRequestHandler):
         def log_message(self, fmt, *args):
@@ -236,28 +358,41 @@ def run_lite_proxy(
         def do_GET(self):
             parsed = urlparse(self.path)
             q = {k: v[0] for k, v in parse_qs(parsed.query).items()}
+            height = None
+            if "height" in q:
+                try:
+                    height = int(q["height"])
+                except ValueError:
+                    body = json.dumps({"error": "bad height"}).encode()
+                    self.send_response(400)
+                    self._finish(body)
+                    return
             try:
                 if parsed.path == "/status":
                     out = proxy.status()
                 elif parsed.path == "/commit":
-                    try:
-                        height = int(q["height"]) if "height" in q else None
-                    except ValueError:
-                        body = json.dumps({"error": "bad height"}).encode()
-                        self.send_response(400)
-                        self._finish(body)
-                        return
                     out = proxy.commit(height)
+                elif parsed.path == "/verify_commit":
+                    out = proxy.verify_commit(height)
+                elif parsed.path == "/light_block":
+                    out = proxy.light_block(height)
+                elif parsed.path == "/frontend_stats":
+                    out = proxy.stats()
                 else:
                     self.send_response(404)
                     self.end_headers()
                     return
                 body = json.dumps({"result": out}).encode()
                 self.send_response(200)
+            except (LiteError, ProviderError) as e:
+                # certification failed or the upstream shed us — tell the
+                # client to back off rather than queue behind a dead path
+                body = json.dumps({"error": str(e)}).encode()
+                self.send_response(502)
             except Exception as e:
-                # LiteError/ProviderError, but also a dead backing node
-                # (socket errors) — callers must get an HTTP error, not a
-                # reset connection
+                # anything else (a dead backing node mid-read, codec
+                # surprises) — callers must get an HTTP error, not a reset
+                # connection
                 body = json.dumps({"error": str(e)}).encode()
                 self.send_response(502)
             self._finish(body)
@@ -269,10 +404,4 @@ def run_lite_proxy(
             self.wfile.write(body)
 
     host, _, port = laddr.replace("tcp://", "").rpartition(":")
-    httpd = ThreadingHTTPServer((host or "127.0.0.1", int(port)), Handler)
-    print(f"lite proxy verifying {node_addr} (chain {chain_id}) on {laddr}", flush=True)
-    try:
-        httpd.serve_forever()
-    except KeyboardInterrupt:
-        pass
-    return 0
+    return ThreadingHTTPServer((host or "127.0.0.1", int(port)), Handler)

@@ -89,11 +89,17 @@ class Node(BaseService):
             if config.statesync.snapshot_interval > 0 and hasattr(
                 app, "configure_snapshots"
             ):
+                snap_kwargs = {}
+                if config.statesync.snapshot_format != 1:
+                    # only apps that know about alternative wire formats
+                    # accept the kwarg; format 1 keeps the 4-arg call shape
+                    snap_kwargs["snapshot_format"] = config.statesync.snapshot_format
                 app.configure_snapshots(
                     self.snapshot_store,
                     config.statesync.snapshot_interval,
                     config.statesync.snapshot_chunk_size,
                     config.statesync.snapshot_keep_recent,
+                    **snap_kwargs,
                 )
 
         # handshake: sync app with store/state
@@ -250,6 +256,29 @@ class Node(BaseService):
         self.rpc_server = None
         self.grpc_broadcast = None
         self._rpc_env = None
+
+        # [frontend]: multi-client light-client serving over this node's
+        # own stores (lite/proxy.py LiteProxy + frontend/ package)
+        self.frontend = None
+        self.lite_server = None
+        if config.frontend.enable:
+            from tendermint_tpu.lite.proxy import LiteProxy
+
+            fe = config.frontend
+            pin_h = fe.trusted_height if fe.trusted_height > 0 else None
+            pin_hash = bytes.fromhex(fe.trusted_hash) if fe.trusted_hash else None
+            self.frontend = LiteProxy(
+                self.genesis_doc.chain_id,
+                trust_db=_db("lite_trust"),
+                trusted_height=pin_h,
+                trusted_hash=pin_hash,
+                block_store=self.block_store,
+                state_db=self.state_db,
+                batch_window_s=fe.batch_window_s,
+                batch_max_rows=fe.batch_max_rows,
+                cache_size=fe.cache_size,
+                use_device=fe.use_device,
+            )
 
     def _build_p2p(self, config: Config, state) -> None:
         from tendermint_tpu.blockchain.reactor import BlockchainReactor
@@ -497,6 +526,17 @@ class Node(BaseService):
             self._rpc_env = RPCEnv(self)
             self.rpc_server = RPCServer(self.config.rpc.laddr, self._rpc_env)
             self.rpc_server.start()
+        if self.frontend is not None and self.config.frontend.laddr:
+            from tendermint_tpu.lite.proxy import serve_proxy
+
+            self.lite_server = serve_proxy(
+                self.frontend, self.config.frontend.laddr
+            )
+            threading.Thread(
+                target=self.lite_server.serve_forever,
+                name="lite-frontend",
+                daemon=True,
+            ).start()
         if self.config.rpc.grpc_laddr:
             from tendermint_tpu.abci.grpc import BroadcastAPIServer
 
@@ -573,6 +613,17 @@ class Node(BaseService):
                 continue
             try:
                 svc.stop()
+            except Exception:
+                pass
+        if self.lite_server is not None:
+            try:
+                self.lite_server.shutdown()
+                self.lite_server.server_close()
+            except Exception:
+                pass
+        if self.frontend is not None:
+            try:
+                self.frontend.close()
             except Exception:
                 pass
 

@@ -798,3 +798,178 @@ class WindowPipeline:
                     q.get_nowait()
             except queue.Empty:
                 pass
+
+
+# ---------------------------------------------------------------------------
+# Long-lived lane feed (cross-caller micro-batch aggregation)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class RowVerdict:
+    """One submitted row's slice of a flushed `LaneFeed` batch — the same
+    quorum semantics as `WindowVerdict`, scoped to a single height row."""
+
+    ok: np.ndarray  # (len(row),) bool — per-lane verdicts in row order
+    tally: int  # voting power of valid present lanes
+    committed: bool  # tally*3 > total*2 (STRICT)
+    sigs_ok: bool  # no present lane failed verification
+    batch_rows: int  # rows folded into the dispatch that served this row
+    batch_lanes: int  # present lanes in that dispatch
+    occupancy: float  # lane occupancy of that dispatch
+
+
+class LaneTicket:
+    """Handle for one submitted row; `result()` blocks until the feed's
+    worker flushes the batch the row rode in."""
+
+    __slots__ = ("_ev", "_verdict", "_err")
+
+    def __init__(self):
+        self._ev = threading.Event()
+        self._verdict: Optional[RowVerdict] = None
+        self._err: Optional[BaseException] = None
+
+    def _resolve(self, verdict=None, err=None) -> None:
+        self._verdict = verdict
+        self._err = err
+        self._ev.set()
+
+    def result(self, timeout: Optional[float] = None) -> RowVerdict:
+        if not self._ev.wait(timeout):
+            raise TimeoutError("lane feed flush did not complete in time")
+        if self._err is not None:
+            raise self._err
+        return self._verdict
+
+
+class LaneFeed:
+    """Long-lived lane-feed entry point — `WindowPipeline`'s dual.
+
+    The pipeline streams *windows* one caller already holds; the feed
+    serves many concurrent callers each holding ONE row (a commit's
+    lanes).  `submit()` parks the row for at most `window_s` seconds; a
+    daemon worker folds every row that arrived meanwhile into one
+    lane-packed `verify_window` dispatch (same pack/dispatch trace spans,
+    same breaker + host-fallback guard) and hands each caller its row's
+    verdict slice.  This is the aggregation seam the light-client
+    frontend feeds — the deadline-bounded micro-batch shape the
+    mempool's CheckTx batching proved."""
+
+    def __init__(self, mesh=None, verifier=None,
+                 use_device: Optional[bool] = None, window_s: float = 0.002,
+                 max_rows: int = 64, profile_kind: str = "lane_feed",
+                 on_flush=None):
+        self.mesh = mesh
+        self.verifier = verifier
+        self.use_device = use_device
+        self.window_s = max(0.0, float(window_s))
+        self.max_rows = max(1, int(max_rows))
+        self.profile_kind = profile_kind
+        self.on_flush = on_flush  # (verdict, n_rows, seconds) per flush
+        # observability for tests/benches: rows_in counts every submitted
+        # row, dispatches every flush — their ratio is the realized batch
+        self.dispatches = 0
+        self.rows_in = 0
+        self.lanes_in = 0
+        self._cond = threading.Condition()
+        self._pending: List[tuple] = []  # (vrow, prow, total, ticket)
+        self._deadline = 0.0
+        self._closed = False
+        self._thread: Optional[threading.Thread] = None
+
+    def submit(
+        self,
+        vrow: Sequence[Optional[SigTuple]],
+        prow: Sequence[int],
+        total: int,
+    ) -> LaneTicket:
+        """Park one height row for the next flush; returns immediately."""
+        ticket = LaneTicket()
+        with self._cond:
+            if self._closed:
+                raise RuntimeError("lane feed is closed")
+            if not self._pending:
+                self._deadline = time.monotonic() + self.window_s
+            self._pending.append((list(vrow), list(prow), int(total), ticket))
+            self.rows_in += 1
+            self.lanes_in += sum(1 for it in vrow if it is not None)
+            if self._thread is None:
+                self._thread = threading.Thread(
+                    target=self._worker, name="planner-lane-feed", daemon=True
+                )
+                self._thread.start()
+            self._cond.notify_all()
+        return ticket
+
+    def flush_now(self) -> None:
+        """Collapse the current deadline: pending rows dispatch at once."""
+        with self._cond:
+            self._deadline = 0.0
+            self._cond.notify_all()
+
+    def close(self) -> None:
+        """Stop accepting rows; pending rows still flush before the worker
+        exits (their tickets resolve, never hang)."""
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+
+    def _worker(self) -> None:
+        while True:
+            with self._cond:
+                while not self._pending:
+                    if self._closed:
+                        return
+                    self._cond.wait(0.1)
+                # deadline-bounded collection: hold the batch open for the
+                # remainder of the window unless it filled (or closed) first
+                while len(self._pending) < self.max_rows and not self._closed:
+                    left = self._deadline - time.monotonic()
+                    if left <= 0:
+                        break
+                    self._cond.wait(left)
+                batch, self._pending = self._pending, []
+            self._flush(batch)
+
+    def _flush(self, batch: List[tuple]) -> None:
+        votes = [b[0] for b in batch]
+        powers = [b[1] for b in batch]
+        totals = [b[2] for b in batch]
+        t0 = time.perf_counter()
+        try:
+            verdict = verify_window(
+                votes, powers, totals, mesh=self.mesh, verifier=self.verifier,
+                use_device=self.use_device,
+            )
+        except BaseException as e:
+            for _, _, _, ticket in batch:
+                ticket._resolve(err=e)
+            return
+        seconds = time.perf_counter() - t0
+        self.dispatches += 1
+        try:
+            get_profiler().record(
+                self.profile_kind,
+                lanes_present=verdict.lanes_present,
+                lanes_dispatched=verdict.lanes_dispatched,
+                heights=len(batch),
+                run_seconds=seconds,
+            )
+        except Exception:
+            pass
+        if self.on_flush is not None:
+            try:
+                self.on_flush(verdict, len(batch), seconds)
+            except Exception:
+                pass
+        for i, (vrow, _, _, ticket) in enumerate(batch):
+            ticket._resolve(RowVerdict(
+                ok=np.asarray(verdict.ok[i, : len(vrow)], dtype=bool),
+                tally=int(verdict.tally[i]),
+                committed=bool(verdict.committed[i]),
+                sigs_ok=bool(verdict.sigs_ok[i]),
+                batch_rows=len(batch),
+                batch_lanes=verdict.lanes_present,
+                occupancy=verdict.occupancy,
+            ))

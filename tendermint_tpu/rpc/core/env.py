@@ -345,6 +345,16 @@ class RPCEnv:
             return {"enabled": False}
         return reactor.progress()
 
+    def frontend_status(self) -> dict:
+        """Light-client frontend serving stats (cache hit state, aggregator
+        dispatch/occupancy counters) when [frontend] enable is on."""
+        fe = getattr(self.node, "frontend", None)
+        if fe is None:
+            return {"enabled": False}
+        out = {"enabled": True}
+        out.update(fe.stats())
+        return out
+
     def net_info(self) -> dict:
         sw = getattr(self.node, "switch", None)
         peers = []

@@ -15,12 +15,15 @@ sees it, and the peer that sent it can be punished.
 
 from __future__ import annotations
 
+import zlib
 from typing import List, Sequence
 
 from tendermint_tpu.abci import types as abci
 from tendermint_tpu.crypto import merkle
 
 SNAPSHOT_FORMAT = 1  # opaque app-state blob, fixed-size chunks
+SNAPSHOT_FORMAT_ZLIB = 2  # same chunking, each wire chunk zlib-compressed
+SUPPORTED_FORMATS = (SNAPSHOT_FORMAT, SNAPSHOT_FORMAT_ZLIB)
 DEFAULT_CHUNK_SIZE = 65536
 HASH_SIZE = 32
 
@@ -43,19 +46,45 @@ def manifest_root(chunk_hashes: Sequence[bytes]) -> bytes:
 
 
 def make_snapshot(
-    height: int, data: bytes, chunk_size: int = DEFAULT_CHUNK_SIZE
+    height: int,
+    data: bytes,
+    chunk_size: int = DEFAULT_CHUNK_SIZE,
+    *,
+    format: int = SNAPSHOT_FORMAT,
 ) -> tuple:
-    """Chunk `data` and build the (Snapshot, chunks) pair for `height`."""
+    """Chunk `data` and build the (Snapshot, chunks) pair for `height`.
+
+    The manifest always covers the WIRE chunks (compressed for format 2),
+    so transport verification (`verify_chunk`) and the app's per-chunk
+    leaf-hash check are format-agnostic; only the final join decodes."""
+    if format not in SUPPORTED_FORMATS:
+        raise ValueError(f"unsupported snapshot format {format}")
     chunks = chunk_state(data, chunk_size)
+    if format == SNAPSHOT_FORMAT_ZLIB:
+        chunks = [zlib.compress(c) for c in chunks]
     hashes = [merkle.leaf_hash(c) for c in chunks]
     snap = abci.Snapshot(
         height=height,
-        format=SNAPSHOT_FORMAT,
+        format=format,
         chunks=len(chunks),
         hash=manifest_root(hashes),
         metadata=b"".join(hashes),
     )
     return snap, chunks
+
+
+def decode_chunk(chunk: bytes, format: int) -> bytes:
+    """Wire chunk -> app-state bytes for `format`.  Raises ValueError on an
+    unknown format or a chunk that does not decompress (a manifest-valid
+    chunk that fails here means the PRODUCER was corrupt, not the wire)."""
+    if format == SNAPSHOT_FORMAT:
+        return chunk
+    if format == SNAPSHOT_FORMAT_ZLIB:
+        try:
+            return zlib.decompress(chunk)
+        except zlib.error as e:
+            raise ValueError(f"zlib chunk did not decompress: {e}") from e
+    raise ValueError(f"unsupported snapshot format {format}")
 
 
 def chunk_hashes_from_metadata(snapshot: abci.Snapshot) -> List[bytes]:

@@ -225,16 +225,34 @@ class StateSyncReactor(Reactor):
 
     def _serve_light_block(self, peer, height: int) -> None:
         raw = b""
+        chain_id = self._chain_id()
         if self.block_store is not None and self.state_db is not None:
             try:
                 provider = NodeProvider(self.block_store, self.state_db)
-                chain_id = self._chain_id()
                 if chain_id:
                     raw = provider.full_commit_at(chain_id, height).marshal()
             except ProviderError:
                 pass
             except Exception:
                 self.logger.exception("serving light block %d failed", height)
+        if not raw and self.state_db is not None and chain_id:
+            # the block store may be pruned (or this node itself restored
+            # via statesync) — a light-client trust store persisted under
+            # the same state DB can still serve the exact height
+            try:
+                from tendermint_tpu.lite.provider import DBProvider
+
+                raw = (
+                    DBProvider(self.state_db)
+                    .latest_full_commit(chain_id, height, height)
+                    .marshal()
+                )
+            except ProviderError:
+                pass
+            except Exception:
+                self.logger.exception(
+                    "trust-store fallback for light block %d failed", height
+                )
         self.metrics.served.add(1.0, ("light_block",))
         peer.try_send(
             STATESYNC_CHANNEL,

@@ -129,7 +129,7 @@ class StateSyncer:
                 key = (snapshot.height, snapshot.format, snapshot.hash)
                 if key in rejected:
                     continue
-                if snapshot.format != chunker.SNAPSHOT_FORMAT:
+                if snapshot.format not in chunker.SUPPORTED_FORMATS:
                     continue
                 if snapshot.height <= 0 or snapshot.chunks <= 0:
                     continue
@@ -172,6 +172,13 @@ class StateSyncer:
             for pid in offer_peers:
                 reactor.ban_peer(pid, "snapshot sender rejected by app")
             raise _SnapshotRejected("sender rejected by app")
+        if res.result == abci.OFFER_SNAPSHOT_REJECT_FORMAT:
+            # format negotiation: this (height, format, hash) goes on the
+            # rejected set and discovery retries the next advertised format
+            # of the same snapshot (peers offer every format they hold)
+            raise _SnapshotRejected(
+                f"app rejected snapshot format {snapshot.format}"
+            )
         if res.result != abci.OFFER_SNAPSHOT_ACCEPT:
             raise _SnapshotRejected(f"app result {res.result}")
 

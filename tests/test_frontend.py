@@ -1,0 +1,529 @@
+"""Light-client frontend: lane aggregation, per-height dedup, verdict
+parity with the serial DynamicVerifier, rejection paths through the
+batched pipeline, provider resilience, and snapshot format negotiation.
+"""
+
+import base64
+import socket
+import threading
+
+import numpy as np
+import pytest
+
+from tendermint_tpu.abci import types as abci
+from tendermint_tpu.abci.examples.kvstore import PersistentKVStoreApp
+from tendermint_tpu.crypto.keys import PrivKeyEd25519
+from tendermint_tpu.frontend import HeaderCache, LiteFrontend, SingleFlight
+from tendermint_tpu.libs.db.kv import MemDB
+from tendermint_tpu.lite.provider import DBProvider, NodeProvider, ProviderError
+from tendermint_tpu.lite.types import LiteError
+from tendermint_tpu.lite.proxy import RPCProvider
+from tendermint_tpu.lite.verifier import DynamicVerifier
+from tendermint_tpu.parallel.planner import LaneFeed, verify_window
+from tendermint_tpu.statesync import SnapshotStore, chunker
+from tendermint_tpu.testutil.chain import build_chain
+from tendermint_tpu.types import MockPV
+
+
+def _val_tx(pv, power: int) -> bytes:
+    return b"val:" + base64.b64encode(pv.get_pub_key().bytes()) + b"!%d" % power
+
+
+@pytest.fixture(scope="module")
+def static_chain():
+    return build_chain(n_vals=4, n_heights=10, chain_id="fe-static")
+
+
+@pytest.fixture(scope="module")
+def churn_chain():
+    """Valset churn forcing bisection (same shape as test_lite's fixture):
+    3 big validators join at h4, 3 originals leave at h8."""
+    joiners = [
+        MockPV(PrivKeyEd25519.generate(bytes([80 + i]) * 32)) for i in range(3)
+    ]
+
+    def on_height(h, st):
+        if h == 4:
+            return [_val_tx(pv, 100) for pv in joiners]
+        if h == 8:
+            leavers = [
+                v for v in st.validators.validators if v.voting_power == 10
+            ][:3]
+            return [
+                b"val:" + base64.b64encode(v.pub_key.bytes()) + b"!0"
+                for v in leavers
+            ]
+        return []
+
+    return build_chain(
+        n_vals=4,
+        n_heights=14,
+        chain_id="fe-churn",
+        app_factory=PersistentKVStoreApp,
+        on_height=on_height,
+        extra_pvs=joiners,
+    )
+
+
+def _frontend(fx, source=None, **kw):
+    src = source or NodeProvider(fx.block_store, fx.state_db)
+    fe = LiteFrontend(fx.chain_id, src, batch_window_s=0.001, **kw)
+    fe.init_trust(
+        NodeProvider(fx.block_store, fx.state_db).full_commit_at(fx.chain_id, 1)
+    )
+    return fe
+
+
+class _DoctoringProvider:
+    def __init__(self, inner, doctor):
+        self._inner = inner
+        self._doctor = doctor
+
+    def full_commit_at(self, chain_id, height):
+        return self._doctor(height, self._inner.full_commit_at(chain_id, height))
+
+    def latest_full_commit(self, chain_id, min_height, max_height):
+        return self.full_commit_at(chain_id, max_height)
+
+
+# ---------------------------------------------------------------------------
+# LaneFeed: cross-caller aggregation with per-row verdicts
+# ---------------------------------------------------------------------------
+
+
+def _signed_row(n_sigs, seed):
+    row = []
+    for j in range(n_sigs):
+        priv = PrivKeyEd25519.generate(bytes([seed, j + 1]) * 16)
+        msg = b"lane-feed-msg-%d-%d" % (seed, j)
+        row.append((priv.pub_key().bytes(), msg, priv.sign(msg)))
+    return row
+
+
+class TestLaneFeed:
+    def test_concurrent_submits_fold_into_shared_dispatches(self):
+        feed = LaneFeed(window_s=0.05, max_rows=64, use_device=False)
+        rows = [_signed_row(4, i + 1) for i in range(12)]
+        verdicts = [None] * len(rows)
+
+        def submit(i):
+            t = feed.submit(rows[i], [1] * 4, 4)
+            verdicts[i] = t.result(30.0)
+
+        ts = [
+            threading.Thread(target=submit, args=(i,))
+            for i in range(len(rows))
+        ]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        feed.close()
+        assert feed.rows_in == len(rows)
+        # the whole burst fits one window, so it must NOT have gone out as
+        # 12 serial dispatches
+        assert feed.dispatches < len(rows)
+        for v in verdicts:
+            assert v.sigs_ok and v.committed
+            assert v.ok.shape == (4,) and v.ok.all()
+            assert 0.0 < v.occupancy <= 1.0
+
+    def test_row_verdicts_bit_identical_to_direct_verify_window(self):
+        good = _signed_row(4, 33)
+        bad = list(_signed_row(4, 34))
+        for lane in (1, 2):  # forge 2 of 4 equal voters: below 2/3 quorum
+            pub, msg, _ = bad[lane]
+            bad[lane] = (pub, msg, b"\x00" * 64)
+
+        serial = [
+            verify_window([row], [[1] * 4], [4], use_device=False)
+            for row in (good, bad)
+        ]
+
+        feed = LaneFeed(window_s=0.05, max_rows=8, use_device=False)
+        tickets = [feed.submit(row, [1] * 4, 4) for row in (good, bad)]
+        got = [t.result(30.0) for t in tickets]
+        feed.close()
+
+        for want, have in zip(serial, got):
+            assert np.array_equal(np.asarray(want.ok[0]), have.ok)
+            assert int(want.tally[0]) == have.tally
+            assert bool(want.committed[0]) == have.committed
+        assert got[0].committed and not got[1].committed
+
+    def test_closed_feed_rejects_submits(self):
+        feed = LaneFeed(window_s=0.001, use_device=False)
+        feed.close()
+        with pytest.raises(RuntimeError, match="closed"):
+            feed.submit(_signed_row(1, 7), [1], 1)
+
+
+# ---------------------------------------------------------------------------
+# HeaderCache + SingleFlight primitives
+# ---------------------------------------------------------------------------
+
+
+class TestHeaderCache:
+    def test_pin_mismatch_is_a_miss(self):
+        c = HeaderCache(4)
+        c.put(5, "fc5", b"pin-a")
+        assert c.get(5) == "fc5"
+        assert c.get(5, pin=b"pin-a") == "fc5"
+        assert c.get(5, pin=b"pin-b") is None
+
+    def test_lru_evicts_oldest(self):
+        c = HeaderCache(2)
+        c.put(1, "a", b"p")
+        c.put(2, "b", b"p")
+        assert c.get(1) == "a"  # touch 1 so 2 is now oldest
+        c.put(3, "c", b"p")
+        assert c.get(2) is None
+        assert c.get(1) == "a" and c.get(3) == "c"
+
+
+class TestSingleFlight:
+    def test_waiters_share_leader_result(self):
+        sf = SingleFlight()
+        gate = threading.Event()
+        calls = []
+        results = []
+        waits = []
+
+        def work():
+            calls.append(1)
+            gate.wait(5.0)
+            return "shared"
+
+        def run():
+            results.append(sf.do("k", work, on_wait=lambda: waits.append(1)))
+
+        ts = [threading.Thread(target=run) for _ in range(6)]
+        for t in ts:
+            t.start()
+        while len(waits) < 5 and any(t.is_alive() for t in ts):
+            pass
+        gate.set()
+        for t in ts:
+            t.join()
+        assert calls == [1]
+        assert results == ["shared"] * 6
+
+    def test_failures_propagate_and_are_not_cached(self):
+        sf = SingleFlight()
+        with pytest.raises(ValueError):
+            sf.do("k", lambda: (_ for _ in ()).throw(ValueError("boom")))
+        # key retired: a later call runs fresh
+        assert sf.do("k", lambda: 42) == 42
+
+
+# ---------------------------------------------------------------------------
+# LiteFrontend: dedup across clients, parity, rejections
+# ---------------------------------------------------------------------------
+
+
+class TestFrontendConcurrency:
+    def test_concurrent_clients_do_the_work_once(self, churn_chain):
+        fx = churn_chain
+        tip = fx.height
+
+        # baseline: ONE client certifying the tip through its own frontend
+        solo = _frontend(fx)
+        solo.certified_commit(tip)
+        solo_rows = solo.feed.rows_in
+        solo.close()
+        assert solo_rows > 0
+
+        # 16 concurrent clients against a shared frontend must not redo
+        # per-height work: same row count as the single client
+        fe = _frontend(fx)
+        heads = []
+        errs = []
+
+        def client():
+            try:
+                heads.append(
+                    fe.certified_commit(tip).signed_header.header.hash()
+                )
+            except Exception as e:  # pragma: no cover - fail loudly below
+                errs.append(e)
+
+        ts = [threading.Thread(target=client) for _ in range(16)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert not errs
+        assert len(set(heads)) == 1
+        assert fe.feed.rows_in == solo_rows
+        st = fe.stats()
+        assert st["cache_entries"] == 1
+        assert st["dispatches"] <= solo_rows
+        fe.close()
+
+    def test_cache_hit_skips_reverification(self, static_chain):
+        fe = _frontend(static_chain)
+        fc = fe.certified_commit(7)
+        rows = fe.feed.rows_in
+        again = fe.certified_commit(7)
+        assert again is fc
+        assert fe.feed.rows_in == rows  # no new signature work
+        fe.close()
+
+
+class TestFrontendParity:
+    def test_bit_identical_with_serial_dynamic_verifier(self, churn_chain):
+        fx = churn_chain
+        src = NodeProvider(fx.block_store, fx.state_db)
+
+        fe = _frontend(fx)
+        fc_batched = fe.certified_commit(fx.height)
+        raw_batched = fe.light_block(fx.height)
+
+        dv = DynamicVerifier(fx.chain_id, DBProvider(MemDB()), src)
+        dv.init_from_full_commit(src.full_commit_at(fx.chain_id, 1))
+        fc_serial = src.full_commit_at(fx.chain_id, fx.height)
+        dv.verify(fc_serial.signed_header)
+
+        assert raw_batched == fc_serial.marshal()
+        assert (
+            fc_batched.signed_header.header.hash()
+            == fc_serial.signed_header.header.hash()
+        )
+        # both paths extended trust to the same frontier
+        assert (
+            fe.trusted.latest_full_commit(fx.chain_id, 1, 1 << 60).height
+            == dv.trusted.latest_full_commit(fx.chain_id, 1, 1 << 60).height
+        )
+        fe.close()
+
+
+class TestFrontendRejections:
+    """The serial verifier's rejection semantics must survive batching —
+    same error types, and nothing becomes trusted or cached."""
+
+    def test_valset_hash_mismatch_rejected_for_every_client(self, static_chain):
+        from tendermint_tpu.crypto.keys import PrivKeyEd25519 as PK
+        from tendermint_tpu.types.validator_set import Validator, ValidatorSet
+
+        fx = static_chain
+        strangers = ValidatorSet(
+            [
+                Validator(PK.generate(bytes([230 + i]) * 32).pub_key(), 10)
+                for i in range(4)
+            ]
+        )
+
+        def swap_valset(height, fc):
+            if height >= 5:
+                fc.validators = strangers
+            return fc
+
+        src = _DoctoringProvider(
+            NodeProvider(fx.block_store, fx.state_db), swap_valset
+        )
+        fe = _frontend(fx, source=src)
+        errs = []
+
+        def client():
+            try:
+                fe.certified_commit(7)
+            except Exception as e:
+                errs.append(e)
+
+        ts = [threading.Thread(target=client) for _ in range(4)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert len(errs) == 4
+        for e in errs:
+            assert isinstance(e, LiteError)
+            assert "validators_hash" in str(e)
+        assert len(fe.cache) == 0  # a failed certification is never cached
+        with pytest.raises(LiteError, match="validators_hash"):
+            fe.certified_commit(7)  # and not single-flight-cached either
+        fe.close()
+
+    def test_insufficient_power_rejected_through_batched_path(
+        self, static_chain
+    ):
+        from tendermint_tpu.types.validator_set import CommitError
+
+        fx = static_chain
+
+        def strip_commit(height, fc):
+            if height > 1:
+                pcs = fc.signed_header.commit.precommits
+                pcs[0] = None
+                pcs[1] = None
+            return fc
+
+        src = _DoctoringProvider(
+            NodeProvider(fx.block_store, fx.state_db), strip_commit
+        )
+        fe = _frontend(fx, source=src)
+        with pytest.raises(CommitError, match="voting power"):
+            fe.certified_commit(9)
+        assert len(fe.cache) == 0
+        fe.close()
+
+
+# ---------------------------------------------------------------------------
+# RPCProvider resilience: bounded retries surface ProviderError
+# ---------------------------------------------------------------------------
+
+
+class TestRPCProviderResilience:
+    def test_refused_connection_surfaces_provider_error(self):
+        # grab a port and close it so nothing listens there
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        p = RPCProvider(f"127.0.0.1:{port}", timeout=0.2, retries=1,
+                        backoff=0.01)
+        with pytest.raises(ProviderError, match="unreachable"):
+            p.full_commit_at("any-chain", 3)
+
+    def test_hung_upstream_times_out_with_bounded_retries(self):
+        # a listener that never answers: connect succeeds, read times out
+        srv = socket.socket()
+        srv.bind(("127.0.0.1", 0))
+        srv.listen(4)
+        port = srv.getsockname()[1]
+        try:
+            p = RPCProvider(f"127.0.0.1:{port}", timeout=0.2, retries=2,
+                            backoff=0.01)
+            with pytest.raises(ProviderError, match="unreachable"):
+                p.latest_full_commit("any-chain", 1, 10)
+        finally:
+            srv.close()
+
+
+# ---------------------------------------------------------------------------
+# Snapshot format 2 (zlib) + format negotiation
+# ---------------------------------------------------------------------------
+
+
+class TestSnapshotFormat2:
+    def test_roundtrip_and_wire_verification(self):
+        blob = (b'{"kv": {"a": "' + b"x" * 5000 + b'"}}')
+        snap, chunks = chunker.make_snapshot(7, blob, 512, format=2)
+        assert snap.format == chunker.SNAPSHOT_FORMAT_ZLIB
+        assert snap.chunks == len(chunks)
+        # manifest covers the WIRE chunks: transport verification needs no
+        # format knowledge
+        hashes = chunker.chunk_hashes_from_metadata(snap)
+        assert all(
+            chunker.verify_chunk(c, i, hashes) for i, c in enumerate(chunks)
+        )
+        joined = b"".join(chunker.decode_chunk(c, snap.format) for c in chunks)
+        assert joined == blob
+        assert sum(len(c) for c in chunks) < len(blob)  # it compressed
+
+    def test_decode_rejects_garbage_and_unknown_formats(self):
+        assert chunker.decode_chunk(b"raw", 1) == b"raw"
+        with pytest.raises(ValueError, match="decompress"):
+            chunker.decode_chunk(b"not zlib", 2)
+        with pytest.raises(ValueError, match="format"):
+            chunker.decode_chunk(b"x", 99)
+        with pytest.raises(ValueError, match="format"):
+            chunker.make_snapshot(1, b"x", format=99)
+
+    def test_kvstore_produces_and_restores_format2(self):
+        app = PersistentKVStoreApp()
+        store = SnapshotStore(MemDB())
+        app.configure_snapshots(store, 3, chunk_size=64, snapshot_format=2)
+        for h in range(1, 7):
+            app.begin_block(abci.RequestBeginBlock())
+            for j in range(3):
+                app.deliver_tx(
+                    abci.RequestDeliverTx(tx=b"k%d-%d=v%d" % (h, j, h))
+                )
+            app.end_block(abci.RequestEndBlock())
+            app.commit(abci.RequestCommit())
+        app.wait_snapshots()
+        snap = store.get(6, chunker.SNAPSHOT_FORMAT_ZLIB)
+        assert snap is not None and snap.format == 2
+
+        app2 = PersistentKVStoreApp()
+        res = app2.offer_snapshot(
+            abci.RequestOfferSnapshot(snapshot=snap, app_hash=app._app_hash())
+        )
+        assert res.result == abci.OFFER_SNAPSHOT_ACCEPT
+        for i in range(snap.chunks):
+            chunk = store.load_chunk(snap.height, snap.format, i)
+            res = app2.apply_snapshot_chunk(
+                abci.RequestApplySnapshotChunk(index=i, chunk=chunk)
+            )
+            assert res.result == abci.APPLY_CHUNK_ACCEPT
+        assert app2.height == 6
+        assert app2.state == app.state
+        assert app2._app_hash() == app._app_hash()
+
+    def test_corrupt_producer_rejected_at_final_decode(self):
+        # wire-valid chunks that are not zlib: manifest verifies, decode
+        # must reject the SNAPSHOT, not crash the app
+        blob = b'{"height": 3, "size": 0, "kv": {}, "vals": {}}'
+        snap, chunks = chunker.make_snapshot(3, blob, 16, format=1)
+        snap = __import__("dataclasses").replace(snap, format=2)
+        app = PersistentKVStoreApp()
+        res = app.offer_snapshot(abci.RequestOfferSnapshot(snapshot=snap))
+        assert res.result == abci.OFFER_SNAPSHOT_ACCEPT
+        for i, chunk in enumerate(chunks):
+            res = app.apply_snapshot_chunk(
+                abci.RequestApplySnapshotChunk(index=i, chunk=chunk)
+            )
+        assert res.result == abci.APPLY_CHUNK_REJECT_SNAPSHOT
+
+    def test_discovery_accepts_both_formats_and_honors_rejections(
+        self, static_chain
+    ):
+        from tendermint_tpu.config.config import StateSyncConfig
+        from tendermint_tpu.libs.metrics import StateSyncMetrics
+        from tendermint_tpu.blockchain.store import BlockStore
+        from tendermint_tpu.statesync.syncer import StateSyncer
+
+        fx = static_chain
+        syncer = StateSyncer(
+            StateSyncConfig(discovery_time=0.01), fx.chain_id, fx.genesis,
+            None, MemDB(), BlockStore(MemDB()), metrics=StateSyncMetrics(),
+        )
+        blob = b"state"
+        snap1, _ = chunker.make_snapshot(5, blob, 16, format=1)
+        snap2, _ = chunker.make_snapshot(5, blob, 16, format=2)
+        import dataclasses
+
+        snap_bad = dataclasses.replace(snap1, format=99)
+
+        class _Reactor:
+            def __init__(self, offers):
+                self._offers = offers
+                self.polls = 0
+
+            def broadcast_snapshot_request(self):
+                pass
+
+            def wait(self, t):
+                self.polls += 1
+                return self.polls <= 2  # give up after two polls
+
+            def snapshot_offers(self):
+                return self._offers
+
+        # unknown format is skipped, format 2 is eligible
+        r = _Reactor([(snap_bad, {"p1"}), (snap2, {"p1"})])
+        picked = syncer._discover(r, rejected=set())
+        assert picked is not None and picked[0].format == 2
+
+        # once (height, format, hash) is rejected — e.g. the app answered
+        # REJECT_FORMAT — discovery falls through to the other format
+        rejected = {(snap2.height, snap2.format, snap2.hash)}
+        r = _Reactor([(snap2, {"p1"}), (snap1, {"p1"})])
+        picked = syncer._discover(r, rejected=rejected)
+        assert picked is not None and picked[0].format == 1
+
+        # everything rejected -> discovery drains and returns None
+        rejected.add((snap1.height, snap1.format, snap1.hash))
+        r = _Reactor([(snap2, {"p1"}), (snap1, {"p1"})])
+        assert syncer._discover(r, rejected=rejected) is None
