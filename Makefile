@@ -4,7 +4,8 @@ PYTHON ?= python
 
 .PHONY: test test_slow test_sanitizers bench bench-local bench_fastsync \
         planner-bench pallas-bench bench_secp bench_multisig mempool-bench \
-        lite-bench metrics-lint bench-check statesync-smoke flight-smoke chaos-smoke \
+        lite-bench multichip-bench metrics-lint bench-check statesync-smoke \
+        flight-smoke chaos-smoke \
         localnet-start localnet-stop build-docker-localnode
 
 test:
@@ -62,6 +63,14 @@ mempool-bench:
 # multi-client light-client frontend vs per-client serial verification
 lite-bench:
 	JAX_PLATFORMS=cpu $(PYTHON) scripts/bench_lite.py $(ARGS)
+
+# multi-window mesh superdispatch scaling 1 -> 8 forced-CPU devices; appends
+# a MULTICHIP_rNN.json round then gates planner_windows_per_s against the
+# previous parsed round
+multichip-bench:
+	JAX_PLATFORMS=cpu $(PYTHON) scripts/bench_multichip.py $(ARGS)
+	$(PYTHON) scripts/bench_check.py --prefix MULTICHIP \
+	  --metric planner_windows_per_s:0.25:higher
 
 # strict text-format v0.0.4 self-check of Registry.expose_text(); pass files
 # to lint scrape snapshots: make metrics-lint ARGS="/tmp/m.prom"

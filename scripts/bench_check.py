@@ -48,6 +48,9 @@ DEFAULT_METRICS = [
     "pallas_ladder_window_slope:0.25:lower",
     # light-client frontend headline (scripts/bench_lite.py / make lite-bench)
     "lite_frontend_headers_per_s:0.25:higher",
+    # multi-window mesh superdispatch headline (scripts/bench_multichip.py /
+    # make multichip-bench — MULTICHIP_r*.json rounds via --prefix)
+    "planner_windows_per_s:0.25:higher",
 ]
 DEFAULT_THRESHOLD = 0.20
 
@@ -86,11 +89,12 @@ class MetricSpec:
         return change if change > self.threshold else None
 
 
-def load_rounds(root: str):
+def load_rounds(root: str, prefix: str = "BENCH"):
     """[(round_number, path, parsed-dict or None)] sorted oldest→newest."""
     rounds = []
-    for path in glob.glob(os.path.join(root, "BENCH_r*.json")):
-        m = re.search(r"BENCH_r(\d+)\.json$", os.path.basename(path))
+    for path in glob.glob(os.path.join(root, f"{prefix}_r*.json")):
+        m = re.search(
+            rf"{re.escape(prefix)}_r(\d+)\.json$", os.path.basename(path))
         if not m:
             continue
         try:
@@ -113,10 +117,10 @@ def _metric_value(parsed: Optional[dict], name: str) -> Optional[float]:
     return float(v) if isinstance(v, (int, float)) else None
 
 
-def check(root: str, specs: List[MetricSpec]) -> int:
-    rounds = load_rounds(root)
+def check(root: str, specs: List[MetricSpec], prefix: str = "BENCH") -> int:
+    rounds = load_rounds(root, prefix)
     if not rounds:
-        print("bench-check: no BENCH_r*.json files — nothing to compare")
+        print(f"bench-check: no {prefix}_r*.json files — nothing to compare")
         return 0
     newest_n, newest_path, newest_parsed = rounds[-1]
     failed = 0
@@ -175,7 +179,10 @@ def main(argv=None) -> int:
                         "don't set their own (default 0.20)")
     p.add_argument("--dir", default=os.path.dirname(
         os.path.dirname(os.path.abspath(__file__))
-    ), help="directory holding BENCH_r*.json")
+    ), help="directory holding the round ledger")
+    p.add_argument("--prefix", default="BENCH",
+                   help="round-file prefix: compare PREFIX_r*.json "
+                        "(default BENCH; multichip rounds use MULTICHIP)")
     args = p.parse_args(argv)
     raw = args.metric or list(DEFAULT_METRICS)
     try:
@@ -183,7 +190,7 @@ def main(argv=None) -> int:
     except ValueError as e:
         print(f"bench-check: {e}", file=sys.stderr)
         return 2
-    return check(args.dir, specs)
+    return check(args.dir, specs, args.prefix)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,215 @@
+"""Multi-window mesh superdispatch bench: 1 → N devices scaling.
+
+Streams many small commit windows (the RPC-burst / frontend shape the
+planner's bucket padding used to waste a whole tile on) through two
+shapes:
+
+  * n=1  — the legacy flat path: one ``verify_window`` dispatch per
+    window, single device, device-side reduction.  This is exactly what
+    every window cost before superdispatch existed, so it is the honest
+    scaling baseline.
+  * n>1  — ``verify_windows`` superdispatches: ``windows_per_device × n``
+    windows folded into ONE lane tile, sharded over an n-device mesh
+    with host-side tally reduction (psum-free).
+
+Devices are CPU streams forced via
+``XLA_FLAGS=--xla_force_host_platform_device_count=8`` so the bench runs
+anywhere; on a real pod the same code shards over the chips.  All
+compiles are warmed before timing (the gate measures steady-state
+throughput, not jit latency) and every superdispatch verdict is checked
+bit-identical against the flat host reference before any number is
+reported.
+
+Writes the next ``MULTICHIP_rNN.json`` round with a ``parsed`` dict;
+``make multichip-bench`` runs this then gates
+``planner_windows_per_s`` via ``bench_check.py --prefix MULTICHIP``.
+
+Usage: python scripts/bench_multichip.py [--windows 64] [--sigs 8]
+                                         [--reps 2] [--devices 1,2,4,8]
+                                         [--round-dir REPO_ROOT]
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+import time
+
+# device fan-out must be pinned BEFORE jax imports
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _window_stream(n_windows: int, n_sigs: int):
+    """n_windows independent 1-height commit windows, n_sigs valid votes
+    each, power 1 per vote (strict +2/3 met exactly when all verify)."""
+    from tendermint_tpu.crypto import ed25519 as ed
+
+    specs = []
+    for w in range(n_windows):
+        vrow, prow = [], []
+        for v in range(n_sigs):
+            seed = bytes([(w % 250) + 1, (v % 250) + 1, 7]) * 16
+            priv = ed.gen_privkey(seed[:32])
+            msg = b"multichip-%d-%d" % (w, v)
+            vrow.append((priv[32:], msg, ed.sign(priv, msg)))
+            prow.append(1)
+        specs.append(([vrow], [prow], [n_sigs]))
+    return specs
+
+
+def _check_parity(got, specs, planner):
+    """Every superdispatch verdict must match the flat HOST path bit for
+    bit — a silently-fallen-back or wrong mesh result must never post a
+    throughput number."""
+    import numpy as np
+
+    for w, (votes, powers, totals) in enumerate(specs):
+        ref = planner.verify_window(votes, powers, totals, use_device=False)
+        v = got[w]
+        if not (
+            np.array_equal(v.ok, ref.ok)
+            and np.array_equal(v.tally, ref.tally)
+            and np.array_equal(v.committed, ref.committed)
+            and np.array_equal(v.sigs_ok, ref.sigs_ok)
+        ):
+            raise SystemExit(f"parity FAILED at window {w}")
+
+
+def _write_round(round_dir: str, parsed: dict, tail: str) -> str:
+    ns = [
+        int(m.group(1))
+        for p in glob.glob(os.path.join(round_dir, "MULTICHIP_r*.json"))
+        if (m := re.search(r"MULTICHIP_r(\d+)\.json$", os.path.basename(p)))
+    ]
+    path = os.path.join(
+        round_dir, f"MULTICHIP_r{max(ns, default=0) + 1:02d}.json")
+    with open(path, "w") as f:
+        json.dump({"rc": 0, "tail": tail, "parsed": parsed}, f, indent=2)
+        f.write("\n")
+    print(f"# bench round -> {path}", file=sys.stderr)
+    return path
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--windows", type=int, default=64)
+    p.add_argument("--sigs", type=int, default=8)
+    p.add_argument("--reps", type=int, default=2,
+                   help="timed repetitions per config; best rep reported")
+    p.add_argument("--devices", default="1,2,4,8",
+                   help="device counts to sweep (1 runs the flat legacy path)")
+    p.add_argument("--round-dir", default=os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))),
+        help="where MULTICHIP_rNN.json rounds land ('' skips the round)")
+    args = p.parse_args()
+
+    import numpy as np
+    import jax
+    from jax.sharding import Mesh
+
+    from tendermint_tpu.libs.breaker import configure_device_guard
+    from tendermint_tpu.parallel import planner
+
+    devs = jax.devices()
+    sweep = [int(s) for s in args.devices.split(",") if s]
+    if max(sweep) > len(devs):
+        print(f"# only {len(devs)} devices — trimming sweep", file=sys.stderr)
+        sweep = [n for n in sweep if n <= len(devs)]
+    # first dispatch per bucket compiles; don't let the guard deadline
+    # misread jit latency as a hung device (timed reps are warm anyway)
+    configure_device_guard(dispatch_deadline=600.0)
+
+    specs = _window_stream(args.windows, args.sigs)
+    print(json.dumps({
+        "stage": "fixture", "windows": args.windows, "sigs": args.sigs,
+        "devices_available": len(devs),
+    }), flush=True)
+
+    results = {}
+    for n in sweep:
+        if n == 1:
+            planner.set_reduce_mode("device")
+            mesh, wpd, mode = None, 1, "flat"
+
+            def run_stream():
+                return [
+                    planner.verify_window(v, pw, t, use_device=True)
+                    for v, pw, t in specs
+                ]
+        else:
+            planner.set_reduce_mode("host")
+            mesh = Mesh(np.asarray(devs[:n]), ("lanes",))
+            wpd = planner.windows_per_dispatch(mesh)
+            mode = "superdispatch"
+
+            def run_stream(mesh=mesh, wpd=wpd):
+                out = []
+                for i in range(0, len(specs), wpd):
+                    out.extend(planner.verify_windows(
+                        specs[i:i + wpd], mesh=mesh, use_device=True))
+                return out
+
+        verdicts = run_stream()  # warm the bucket's compile
+        _check_parity(verdicts, specs, planner)
+        best = float("inf")
+        for _ in range(args.reps):
+            t0 = time.perf_counter()
+            run_stream()
+            best = min(best, time.perf_counter() - t0)
+        rate = len(specs) / best
+        results[n] = rate
+        print(json.dumps({
+            "stage": f"n{n}", "mode": mode, "windows_per_dispatch": wpd,
+            "windows_per_s": round(rate, 2), "seconds": round(best, 3),
+        }), flush=True)
+
+    planner.set_reduce_mode("device")
+    configure_device_guard()
+
+    base = results.get(1)
+    top_n = max(results)
+    parsed = {
+        "planner_windows_per_s": round(results[top_n], 2),
+        "planner_windows_per_s_1dev": round(base, 2) if base else None,
+        "planner_scaling_1_to_8": (
+            round(results[top_n] / base, 2) if base else None
+        ),
+        "windows": args.windows,
+        "sigs_per_window": args.sigs,
+        "sweep": {
+            str(n): {
+                "windows_per_s": round(r, 2),
+                # efficiency vs perfect linear scaling of the flat baseline
+                "efficiency": round(r / (base * n), 3) if base else None,
+            }
+            for n, r in results.items()
+        },
+        "parity": True,
+    }
+    tail = json.dumps({
+        "metric": "planner_windows_per_s",
+        "value": parsed["planner_windows_per_s"],
+        "unit": "windows/s",
+        **{k: parsed[k] for k in (
+            "planner_windows_per_s_1dev", "planner_scaling_1_to_8", "parity",
+        )},
+    })
+    print(tail, flush=True)
+    if args.round_dir:
+        _write_round(args.round_dir, parsed, tail)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

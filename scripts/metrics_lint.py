@@ -309,6 +309,10 @@ def _self_check():
     vm.device_retries.add(1.0)
     vm.device_audit.add(8.0, ("ok",))
     vm.device_audit.add(1.0, ("mismatch",))
+    # per-device shard attribution (mesh superdispatch) — device ids past
+    # the label cap fold into "overflow", which must still lint
+    vm.record_device_shards((0, 1), 128)
+    vm.record_device_shards((str(i) for i in range(40)), 8)
 
     fm = FrontendMetrics()
     fm.requests.add(3.0, ("verify_commit", "ok"))
@@ -380,6 +384,10 @@ def _self_check():
         # limb-multiplier backend + carry-schedule attribution
         # ([verify] fe_backend / carry_mode label)
         "tendermint_verify_fe_backend_total",
+        # per-device lane/dispatch attribution (mesh superdispatch;
+        # capped `device` label, excess ids fold into "overflow")
+        "tendermint_verify_device_lanes_total",
+        "tendermint_verify_device_dispatch_total",
     )
     verify_text = vm.registry.expose_text()
     missing_dev = [

@@ -218,15 +218,18 @@ class BlockchainReactor(Reactor):
         self.blocks_synced = 0
         self._trusted_commit_heights: set = set()
         self._switched = threading.Event()
-        # double-buffered verify (SURVEY §2.4 pipelining): while the apply
-        # loop walks window N, window N+1's host packing + device dispatch
-        # run on a daemon worker thread — the device wait releases the GIL,
-        # so verify and apply genuinely overlap, and a wedged device can
-        # never block interpreter exit (a ThreadPoolExecutor's non-daemon
-        # workers would, via concurrent.futures' atexit join).  One slot:
+        # pipelined speculative verify (SURVEY §2.4): while the apply loop
+        # walks window N, windows N+1..N+k verify on daemon worker threads
+        # — the device wait releases the GIL, so verify and apply genuinely
+        # overlap, and a wedged device can never block interpreter exit (a
+        # ThreadPoolExecutor's non-daemon workers would, via
+        # concurrent.futures' atexit join).  k = [verify] pipeline_depth - 1
+        # (planner.pipeline_depth()); the default depth 2 keeps exactly ONE
+        # window in flight — the classic double buffer.  Each slot:
         # (first_height, valset hash the speculation assumed, future,
-        # parts, blocks).
-        self._spec: Optional[tuple] = None
+        # parts, blocks); slots chain consecutively, so a harvest mismatch
+        # at the head invalidates every slot behind it too.
+        self._spec: list = []
 
     # -- Reactor interface --------------------------------------------------------
     def get_channels(self):
@@ -252,9 +255,8 @@ class BlockchainReactor(Reactor):
                 self.pool.stop()
             except Exception:
                 pass
-        spec = self._spec  # snapshot: the pool routine clears this slot
-        self._spec = None
-        if spec is not None:
+        specs, self._spec = self._spec, []  # snapshot: pool routine races
+        for spec in specs:
             spec[2].cancel()  # not-yet-started work never runs
 
     def start_from_statesync(self, state) -> None:
@@ -375,27 +377,33 @@ class BlockchainReactor(Reactor):
         return auto_verify_window(self.state.validators.size)
 
     # -- speculative (double-buffered) verify --------------------------------------
+    def _discard_speculation(self, slots) -> None:
+        """Cancel-or-drain invalidated slots.  A running verify must drain —
+        letting it race a fresh synchronous verify would double-dispatch
+        its window through the device."""
+        for _, _, fut, _, _ in slots:
+            get_verify_metrics().speculative.add(1.0, ("miss",))
+            if not fut.cancel():
+                try:
+                    fut.result()
+                except BaseException:
+                    pass
+
     def _take_speculative(self) -> Optional[tuple]:
         """Harvest the in-flight window N+1 verification, if it still
         applies: same start height, and the valset the speculation assumed
         survived window N's apply (an EndBlock valset change invalidates the
         whole speculation — including any 'wrong validators_hash' verdict it
-        produced, which must never punish a peer)."""
-        if self._spec is None:
+        produced, which must never punish a peer).  A head mismatch voids
+        every chained slot behind it too: they all assumed the heights and
+        valset the head promised."""
+        if not self._spec:
             return None
-        first_h, vhash, fut, parts_list, blocks = self._spec
-        self._spec = None
+        head = self._spec.pop(0)
+        first_h, vhash, fut, parts_list, blocks = head
         if first_h != self.pool.height or self.state.validators.hash() != vhash:
-            get_verify_metrics().speculative.add(1.0, ("miss",))
-            if not fut.cancel():
-                # already running: drain it — the single worker must be
-                # free before any new dispatch, and letting it race a
-                # fresh synchronous verify would double-dispatch the
-                # window through the device
-                try:
-                    fut.result()
-                except BaseException:
-                    pass
+            rest, self._spec = self._spec, []
+            self._discard_speculation([head] + rest)
             return None
         try:
             n_ok, err = fut.result()
@@ -407,35 +415,52 @@ class BlockchainReactor(Reactor):
         return blocks, parts_list, n_ok, err
 
     def _start_speculative(self, offset: int) -> None:
-        """Dispatch window N+1's verify while window N applies."""
-        nxt = self.pool.peek_window(self.verify_window + 1, start_offset=offset)
-        if len(nxt) < 2:
-            return
-        st = self.state  # CoW valsets: apply never mutates this snapshot
-        parts_list: list = []
-        fut: Future = Future()
+        """Top the speculation chain up to depth while window N applies.
 
-        def _run():
-            # honor a cancel that lands before the thread gets scheduled;
-            # once running, fut.cancel() returns False and harvest/discard
-            # paths drain instead of racing a second dispatch
-            if not fut.set_running_or_notify_cancel():
+        Depth is [verify] pipeline_depth - 1 slots (planner.pipeline_depth)
+        — the default double buffer dispatches exactly one window ahead,
+        deeper keeps more windows in flight so the mesh stays fed between
+        harvests.  Chained slots start where the previous slot's window
+        ends; any partial apply shows up as a head mismatch at harvest and
+        voids the chain."""
+        from tendermint_tpu.parallel import planner as _planner
+
+        depth = max(1, _planner.pipeline_depth() - 1)
+        while len(self._spec) < depth:
+            if self._spec:
+                last_first, _, _, _, last_blocks = self._spec[-1]
+                offset = (last_first - self.pool.height) + len(last_blocks) - 1
+            nxt = self.pool.peek_window(
+                self.verify_window + 1, start_offset=offset)
+            if len(nxt) < 2:
                 return
-            try:
-                with trace.span(
-                    "fastsync.window", h0=nxt[0].height, n=len(nxt) - 1,
-                    mode="speculative",
-                ):
-                    fut.set_result(
-                        verify_block_window(
-                            st, nxt, self.verifier, parts_list, self.mesh
-                        )
-                    )
-            except BaseException as e:
-                fut.set_exception(e)
+            st = self.state  # CoW valsets: apply never mutates this snapshot
+            parts_list: list = []
+            fut: Future = Future()
 
-        threading.Thread(target=_run, name="bc-verify", daemon=True).start()
-        self._spec = (nxt[0].height, st.validators.hash(), fut, parts_list, nxt)
+            def _run(nxt=nxt, st=st, parts_list=parts_list, fut=fut):
+                # honor a cancel that lands before the thread gets
+                # scheduled; once running, fut.cancel() returns False and
+                # harvest/discard paths drain instead of racing a second
+                # dispatch
+                if not fut.set_running_or_notify_cancel():
+                    return
+                try:
+                    with trace.span(
+                        "fastsync.window", h0=nxt[0].height, n=len(nxt) - 1,
+                        mode="speculative",
+                    ):
+                        fut.set_result(
+                            verify_block_window(
+                                st, nxt, self.verifier, parts_list, self.mesh
+                            )
+                        )
+                except BaseException as e:
+                    fut.set_exception(e)
+
+            threading.Thread(target=_run, name="bc-verify", daemon=True).start()
+            self._spec.append(
+                (nxt[0].height, st.validators.hash(), fut, parts_list, nxt))
 
     def _try_sync_window(self) -> None:
         spec = self._take_speculative()
@@ -533,20 +558,20 @@ class BlockchainReactor(Reactor):
                 self.pool.stop()
             except Exception:
                 pass
-        spec = self._spec
-        self._spec = None
-        if spec is not None and not spec[2].cancel():
-            # drain: the device should be idle before consensus starts its
-            # own commit verifies — but BOUNDED: a wedged tunnel must not
-            # hold the switch to consensus hostage (the daemon worker dies
-            # with the process either way)
-            try:
-                spec[2].result(timeout=30.0)
-            except BaseException:
-                self.logger.warning(
-                    "speculative verify did not drain before consensus "
-                    "switchover (wedged device dispatch?)"
-                )
+        specs, self._spec = self._spec, []
+        for spec in specs:
+            if not spec[2].cancel():
+                # drain: the device should be idle before consensus starts
+                # its own commit verifies — but BOUNDED: a wedged tunnel
+                # must not hold the switch to consensus hostage (the daemon
+                # worker dies with the process either way)
+                try:
+                    spec[2].result(timeout=30.0)
+                except BaseException:
+                    self.logger.warning(
+                        "speculative verify did not drain before consensus "
+                        "switchover (wedged device dispatch?)"
+                    )
         if self.consensus_reactor is not None:
             self.consensus_reactor.switch_to_consensus(
                 self.state.copy(), self.blocks_synced

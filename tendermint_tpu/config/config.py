@@ -228,6 +228,20 @@ class VerifyConfig:
     # audit/breaker machinery cross-checks them like any device backend.
     # TM_FE_BACKEND env overrides.
     fe_backend: str = "vpu"
+    # WindowPipeline depth: packed windows allowed in flight ahead of the
+    # device (host SHA-512/decompress/pack for windows N+1..N+k overlaps
+    # window N's dispatch).  2 = the classic double buffer; deeper keeps
+    # the chips fed when pack time fluctuates across mixed window sizes.
+    pipeline_depth: int = 2
+    # multi-window superdispatch budget: how many independent small
+    # windows the planner may fold into one lane tile PER MESH DEVICE
+    # (parallel/planner.windows_per_dispatch = this × device count)
+    windows_per_device: int = 4
+    # where per-device partial segment tallies reduce: "device" (replicated
+    # segment_sum inside the sharded step) or "host" (psum-free — the step
+    # returns only lane-sharded verdicts and int64 tallies fold on host).
+    # Bit-identical either way; "host" avoids the cross-device collective.
+    planner_reduce: str = "device"
 
 
 @dataclass

@@ -188,6 +188,9 @@ class LiteFrontend:
             "cache_entries": len(self.cache),
             "cache_capacity": self.cache.capacity,
             "dispatches": feed.dispatches,
+            # windows folded into those dispatches — windows_out >
+            # dispatches means racing flushes rode one superdispatch
+            "windows_out": feed.windows_out,
             "rows_in": feed.rows_in,
             "lanes_in": feed.lanes_in,
             "avg_batch_rows": (

@@ -393,6 +393,42 @@ class VerifyMetrics:
             "and carry schedule",
             label_names=("backend", "fe_backend", "carry_mode"),
         )
+        # per-device attribution of mesh superdispatches: which devices the
+        # lane tile sharded across and how many lanes each shard carried.
+        # Label cardinality is capped like NodeMetrics peer labels — at most
+        # MAX_DEVICE_LABELS distinct device ids ever get their own value,
+        # the rest fold into "overflow"
+        self.device_lanes = r.counter(
+            "verify_device_lanes_total",
+            "Lanes dispatched per mesh device (lane-tile shard size)",
+            label_names=("device",),
+        )
+        self.device_dispatches = r.counter(
+            "verify_device_dispatch_total",
+            "Device dispatches that included each mesh device",
+            label_names=("device",),
+        )
+        self._device_label_ids: set = set()
+        self._device_label_mtx = threading.Lock()
+
+    MAX_DEVICE_LABELS = 16
+
+    def _device_label(self, device_id: str) -> str:
+        with self._device_label_mtx:
+            if device_id in self._device_label_ids:
+                return device_id
+            if len(self._device_label_ids) < self.MAX_DEVICE_LABELS:
+                self._device_label_ids.add(device_id)
+                return device_id
+        return "overflow"
+
+    def record_device_shards(self, device_ids, lanes_per_device: int) -> None:
+        """One mesh (or single-device) dispatch: every participating device
+        gets a dispatch tick and its lane-tile shard size attributed."""
+        for d in device_ids:
+            lbl = self._device_label(str(d))
+            self.device_dispatches.add(1.0, (lbl,))
+            self.device_lanes.add(float(lanes_per_device), (lbl,))
 
     def record_dispatch(self, backend: str, algo: str, n: int,
                         seconds: float, rejects: int = 0,

@@ -87,10 +87,16 @@ class Profiler:
         bytes_to_device: int = 0,
         fe_backend: str = "",
         carry_mode: str = "",
+        n_windows: int = 1,
+        n_devices: int = 1,
     ) -> None:
         win = getattr(_tls, "window", None)
         entry = {
             "kind": kind,
+            # superdispatch shape: independent windows folded into this
+            # dispatch and mesh devices the lane tile sharded across
+            "n_windows": int(n_windows),
+            "n_devices": int(n_devices),
             # limb-multiplier backend that served this dispatch
             # (ops/fe_common: vpu | mxu | mxu16; "" = host / not applicable)
             "fe_backend": str(fe_backend),
@@ -180,6 +186,8 @@ class Profiler:
                     "height_base": e["height_base"],
                     "heights": e["heights"],
                     "dispatches": 0,
+                    "windows": 0,
+                    "n_devices": 1,
                     "kinds": [],
                     "fe_backends": [],
                     "carry_modes": [],
@@ -195,6 +203,8 @@ class Profiler:
                 rows[key] = row
                 order.append(key)
             row["dispatches"] += 1
+            row["windows"] += e.get("n_windows", 1)
+            row["n_devices"] = max(row["n_devices"], e.get("n_devices", 1))
             if e["kind"] not in row["kinds"]:
                 row["kinds"].append(e["kind"])
             fb = e.get("fe_backend", "")

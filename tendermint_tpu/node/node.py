@@ -179,6 +179,12 @@ class Node(BaseService):
 
         set_default_fe_backend(getattr(config.verify, "fe_backend", None))
 
+        # [verify] planner knobs: pipeline depth, multi-window superdispatch
+        # budget and the tally reduction side (parallel/planner.py)
+        from tendermint_tpu.parallel.planner import configure_planner
+
+        configure_planner(config.verify)
+
         if self.metrics is not None:
             # slow-subscriber drop accounting (libs/pubsub.py)
             m = self.metrics

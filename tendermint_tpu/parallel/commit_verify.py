@@ -142,17 +142,18 @@ _step_cache = {}
 _compiled_shapes = set()
 
 
-def _compiled_step(mesh, fe_backend: str = "vpu"):
+def _compiled_step(mesh, fe_backend: str = "vpu", carry_mode: str = "lazy"):
     from tendermint_tpu.ops import fe_common as _fc
 
     # the XLA kernel has no mxu16 lowering — degrade to the plane multiplier
     fe_backend = "mxu" if fe_backend in ("mxu", "mxu16") else "vpu"
+    carry_mode = _fc.effective_carry_mode(fe_backend, carry_mode)
     # Mesh hashes by devices+axis_names; id() could be gc-reused
-    key = (mesh, fe_backend)
+    key = (mesh, fe_backend, carry_mode)
     fn = _step_cache.get(key)
     if fn is not None:
         return fn
-    step = _fc.trace_with_backend(_k, _step, fe_backend)
+    step = _fc.trace_with_modes(_k, _step, fe_backend, carry_mode)
     if mesh is None:
         fn = jax.jit(step)
     else:
@@ -354,7 +355,11 @@ def _verify_window_device(
     from tendermint_tpu.crypto.batch import _resolve_fe_backend
 
     fe_backend = _resolve_fe_backend(None)
-    shape_key = (mesh, (ph, pv), fe_backend)
+    from tendermint_tpu.ops import fe_common as _fc
+
+    carry_mode = _fc.effective_carry_mode(
+        "mxu" if fe_backend in ("mxu", "mxu16") else "vpu", "lazy")
+    shape_key = (mesh, (ph, pv), fe_backend, carry_mode)
     first = shape_key not in _compiled_shapes
     _compiled_shapes.add(shape_key)
     n = int(np.count_nonzero(win.present))
@@ -366,19 +371,28 @@ def _verify_window_device(
 
                 hv = NamedSharding(mesh, PS(*mesh.axis_names[:2]))
                 arrs = [jax.device_put(a, hv) for a in arrs]
-            ok, tally, committed = _compiled_step(mesh, fe_backend)(
+            ok, tally, committed = _compiled_step(mesh, fe_backend, carry_mode)(
                 *arrs, np.int64(total_power)
             )
             ok = np.asarray(ok)[:H, :V]
     dt = time.perf_counter() - t0
+    n_devices = int(mesh.devices.size) if mesh is not None else 1
     try:
         # rejects = votes that passed host prechecks but failed the device
         # verify; first dispatch per mesh key carries the jit compile
-        get_verify_metrics().record_dispatch(
+        m = get_verify_metrics()
+        m.record_dispatch(
             backend, "ed25519", n, dt,
             rejects=int(np.count_nonzero(win.present & ~ok)), first=first,
             fe_backend=fe_backend,
+            carry_mode=carry_mode,
         )
+        if mesh is not None:
+            m.record_device_shards(
+                (d.id for d in mesh.devices.flat),
+                (ph * pv) // n_devices)
+        else:
+            m.record_device_shards((jax.devices()[0].id,), ph * pv)
         get_profiler().record(
             backend,
             bucket=(ph, pv),
@@ -390,6 +404,9 @@ def _verify_window_device(
             compiled=first,
             bytes_to_device=sum(a.nbytes for a in arrs),
             fe_backend=fe_backend,
+            carry_mode=carry_mode,
+            n_windows=1,
+            n_devices=n_devices,
         )
     except Exception:
         pass

@@ -18,7 +18,7 @@ votes, carrying a per-lane ``segment_id`` (the height each lane belongs
 to), so the per-height quorum tally is a branch-free ``segment_sum``
 instead of a ``val``-axis reduction over mostly-padding lanes.
 
-Three mechanisms, one per class of waste:
+Four mechanisms, one per class of waste:
 
   1. **Ragged lane packing** (`plan_window`): bin-pack every height's
      present votes into one lane axis; occupancy = Σ_h V_h / bucket(Σ V_h)
@@ -29,12 +29,25 @@ Three mechanisms, one per class of waste:
      ≥ 8, so the jit step compiles once per ``(mesh, lane_bucket,
      seg_bucket)`` instead of once per window shape.  `compile_count()`
      exposes the exact number of compiles for tests and benches.
-  3. **Double-buffered dispatch** (`WindowPipeline`): the host prologue
-     (SHA-512 of sign-bytes, point decompression, limb packing) for window
-     N+1 runs on a worker thread while window N's device dispatch is in
-     flight — JAX dispatch is async and the prologue is numpy/hashlib work
-     that releases the GIL, so the two genuinely overlap (`planner.pack` /
-     `planner.dispatch` trace spans make the overlap visible).
+  3. **Pipelined dispatch** (`WindowPipeline`): the host prologue
+     (SHA-512 of sign-bytes, point decompression, limb packing) for windows
+     N+1..N+k runs on a worker thread while window N's device dispatch is
+     in flight — JAX dispatch is async and the prologue is numpy/hashlib
+     work that releases the GIL, so the two genuinely overlap
+     (`planner.pack` / `planner.dispatch` trace spans make the overlap
+     visible).  The depth k (`[verify] pipeline_depth`) bounds how many
+     packed windows may wait in memory.
+  4. **Multi-window superdispatch** (`plan_windows` / `verify_windows`):
+     several *independent* windows bin-pack into ONE lane tile — the
+     window id is a second segment level above the (height, valset)
+     segment ids, so a single `segment_sum` pass yields per-height tallies
+     for every window in the dispatch.  Small windows (RPC commit-verify
+     bursts, light-frontend rows, backfill tails) stop paying a whole
+     lane bucket each; on a mesh the shared tile shards across all
+     devices so the pod verifies many windows per dispatch.  Per-device
+     partial tallies can be reduced on host (`planner_reduce = "host"`,
+     a psum-free lane-only gather) or on device (the default replicated
+     `segment_sum`) — both are bit-identical int64 math.
 
 Quorum semantics are the ONE shared implementation (`WindowVerdict`):
 ``committed[h] = tally[h] * 3 > totals[h] * 2`` (strict — an exact 2/3
@@ -92,6 +105,70 @@ def segs_bucket(h: int) -> int:
     return b
 
 
+# ---------------------------------------------------------------------------
+# Planner configuration ([verify] section, node.configure_planner)
+# ---------------------------------------------------------------------------
+
+_DEFAULT_PIPELINE_DEPTH = 2
+_DEFAULT_WINDOWS_PER_DEVICE = 4
+
+_pipeline_depth = _DEFAULT_PIPELINE_DEPTH
+_windows_per_device = _DEFAULT_WINDOWS_PER_DEVICE
+_reduce_mode = "device"
+
+REDUCE_MODES = ("device", "host")
+
+
+def configure_planner(cfg=None) -> None:
+    """Apply `[verify]` planner knobs (config.VerifyConfig); None restores
+    the defaults.  Called from node wiring next to configure_device_guard."""
+    global _pipeline_depth, _windows_per_device, _reduce_mode
+    if cfg is None:
+        _pipeline_depth = _DEFAULT_PIPELINE_DEPTH
+        _windows_per_device = _DEFAULT_WINDOWS_PER_DEVICE
+        _reduce_mode = "device"
+        return
+    _pipeline_depth = max(1, int(getattr(
+        cfg, "pipeline_depth", _DEFAULT_PIPELINE_DEPTH)))
+    _windows_per_device = max(1, int(getattr(
+        cfg, "windows_per_device", _DEFAULT_WINDOWS_PER_DEVICE)))
+    mode = str(getattr(cfg, "planner_reduce", "device") or "device").lower()
+    if mode not in REDUCE_MODES:
+        raise ValueError(
+            f"planner_reduce must be one of {REDUCE_MODES}, got {mode!r}")
+    _reduce_mode = mode
+
+
+def pipeline_depth() -> int:
+    """Configured WindowPipeline depth (packed windows in flight)."""
+    return _pipeline_depth
+
+
+def reduce_mode() -> str:
+    """Where per-device partial segment tallies reduce: "device" (replicated
+    segment_sum inside the sharded step) or "host" (the step returns only
+    the lane-sharded verdicts — no cross-device collective — and the int64
+    tallies fold on host; bit-identical either way)."""
+    return _reduce_mode
+
+
+def set_reduce_mode(mode: str) -> None:
+    """Benches/tests: pick the tally reduction side directly."""
+    global _reduce_mode
+    if mode not in REDUCE_MODES:
+        raise ValueError(
+            f"planner_reduce must be one of {REDUCE_MODES}, got {mode!r}")
+    _reduce_mode = mode
+
+
+def windows_per_dispatch(mesh=None) -> int:
+    """How many independent windows a superdispatch should fold: the
+    configured per-device budget times the mesh device count — the pod's
+    unit of parallelism is a window, so capacity scales with the pod."""
+    nd = int(mesh.devices.size) if mesh is not None else 1
+    return _windows_per_device * nd
+
+
 def _pub_bytes(pk) -> bytes:
     """Raw key bytes for device packing: PubKey objects expose .bytes()."""
     b = getattr(pk, "bytes", None)
@@ -123,6 +200,17 @@ class WindowPlan:
     dev: Optional[tuple] = None  # padded device tensors (pack_device)
     dev_shape: Optional[Tuple[int, int]] = None  # (lane bucket, seg bucket)
     pack_seconds: float = 0.0  # host plan+pack wall time (cost ledger)
+    # multi-window superdispatch bookkeeping (plan_windows): the window id
+    # is a second segment level ABOVE the height segment ids — heights of
+    # window w occupy rows [row_offsets[w], row_offsets[w+1]), so the
+    # global seg_ids stay sorted and one segment_sum pass tallies every
+    # window.  window_ids maps each lane to its window; window_V keeps each
+    # window's own grid width so split_verdict can hand back grids shaped
+    # exactly as the flat per-window path would have.
+    n_windows: int = 1
+    row_offsets: Optional[np.ndarray] = None  # (n_windows+1,) int64
+    window_ids: Optional[np.ndarray] = None  # (n,) int32 per-lane window id
+    window_V: Optional[List[int]] = None  # per-window grid width
 
     @property
     def n_lanes(self) -> int:
@@ -206,6 +294,75 @@ def plan_window(
         wellformed=np.asarray(wf, dtype=bool),
         totals=np.asarray(list(totals), dtype=np.int64),
     )
+
+
+def plan_windows(
+    specs: Sequence[Tuple[Sequence, Sequence, Sequence]],
+) -> WindowPlan:
+    """Bin-pack several *independent* windows into ONE lane tile.
+
+    Each spec is a `(votes, powers, totals)` triple exactly as
+    `plan_window` takes them.  Window w's height rows land at
+    [row_offsets[w], row_offsets[w+1]) of the combined plan, so the
+    per-lane seg_ids remain globally sorted and the existing bucketed step
+    — verify kernel + one segment_sum — serves every window in a single
+    dispatch.  `split_verdict` recovers the per-window verdicts, each
+    bit-identical to what a flat `verify_window(spec)` would have said."""
+    specs = list(specs)
+    if not specs:
+        raise ValueError("plan_windows needs at least one window spec")
+    votes_all: List[Sequence] = []
+    powers_all: List[Sequence] = []
+    totals_all: List[int] = []
+    row_offsets = [0]
+    window_V: List[int] = []
+    for votes, powers, totals in specs:
+        votes_all.extend(votes)
+        powers_all.extend(powers)
+        totals_all.extend(list(totals))
+        row_offsets.append(len(votes_all))
+        window_V.append(max((len(row) for row in votes), default=0))
+    plan = plan_window(votes_all, powers_all, totals_all)
+    plan.n_windows = len(specs)
+    plan.row_offsets = np.asarray(row_offsets, dtype=np.int64)
+    plan.window_V = window_V
+    if plan.seg_ids.size:
+        plan.window_ids = np.searchsorted(
+            plan.row_offsets[1:], plan.seg_ids, side="right"
+        ).astype(np.int32)
+    else:
+        plan.window_ids = np.zeros((0,), dtype=np.int32)
+    return plan
+
+
+def split_verdict(plan: WindowPlan, verdict: WindowVerdict) -> List[WindowVerdict]:
+    """Slice a superdispatch verdict back into per-window verdicts.
+
+    Each sub-verdict's grid uses the window's OWN width (window_V), so
+    callers comparing against the flat single-window path see identical
+    shapes.  lanes_dispatched carries the shared lane tile's bucket: the
+    windows paid for it together, so per-window occupancy is reported
+    against the whole tile (the superdispatch's occupancy is the honest
+    one; WindowVerdict.occupancy of a slice under-reports by design)."""
+    if plan.n_windows <= 1 or plan.row_offsets is None:
+        return [verdict]
+    out: List[WindowVerdict] = []
+    offs = plan.row_offsets
+    for w in range(plan.n_windows):
+        a, b = int(offs[w]), int(offs[w + 1])
+        Vw = plan.window_V[w] if plan.window_V is not None else plan.V
+        lanes_w = int(np.count_nonzero(plan.window_ids == w)) if (
+            plan.window_ids is not None
+        ) else 0
+        out.append(WindowVerdict(
+            ok=np.ascontiguousarray(verdict.ok[a:b, :Vw]),
+            tally=verdict.tally[a:b].copy(),
+            committed=verdict.committed[a:b].copy(),
+            sigs_ok=verdict.sigs_ok[a:b].copy(),
+            lanes_present=lanes_w,
+            lanes_dispatched=verdict.lanes_dispatched,
+        ))
+    return out
 
 
 def pack_device(plan: WindowPlan, mesh=None) -> WindowPlan:
@@ -319,10 +476,35 @@ def reset_cache() -> None:
         _compiles = 0
 
 
-def _compiled_step(mesh, B: int, S: int, fe_backend: str = "vpu"):
-    """jit'd step for one (mesh, lane bucket, seg bucket, fe backend);
-    returns (fn, compiled) where compiled marks a cache miss (a real jit
-    trace — padded shapes are fixed per bucket, so key miss == recompile)."""
+def _planner_step_lanes(
+    neg_ax, ay, s_words, h_words, r_limbs, r_sign,
+    present, is_vote, power, seg_ids, totals,
+):
+    """Host-reduction step variant: verify only, NO cross-device work.  The
+    lane-sharded verdict vector is the whole output — each device touches
+    just its own lane shard (psum-free), and the int64 segment tallies fold
+    on host (`_host_reduce`), bit-identically to the device segment_sum."""
+    from tendermint_tpu.ops import ed25519_verify as _k
+
+    raw = _k._verify_kernel(neg_ax, ay, s_words, h_words, r_limbs, r_sign)
+    return raw & present
+
+
+def _resolve_carry_mode(fe_backend: str) -> str:
+    """The carry schedule the planner step traces with — lazy (the batch
+    verifier's optimized schedule) except where the backend has no lazy
+    plan (fe_common.effective_carry_mode's mxu16 degrade)."""
+    from tendermint_tpu.ops import fe_common as _fc
+
+    return _fc.effective_carry_mode(fe_backend, "lazy")
+
+
+def _compiled_step(mesh, B: int, S: int, fe_backend: str = "vpu",
+                   carry_mode: str = "lazy", reduce: str = "device"):
+    """jit'd step for one (mesh, lane bucket, seg bucket, fe backend, carry
+    mode, reduction side); returns (fn, compiled) where compiled marks a
+    cache miss (a real jit trace — padded shapes are fixed per bucket, so
+    key miss == recompile)."""
     global _compiles
     import jax
 
@@ -331,12 +513,14 @@ def _compiled_step(mesh, B: int, S: int, fe_backend: str = "vpu"):
 
     # the XLA kernel has no mxu16 lowering — degrade to the plane multiplier
     fe_backend = "mxu" if fe_backend in ("mxu", "mxu16") else "vpu"
-    key = (mesh, B, S, fe_backend)
+    carry_mode = _fc.effective_carry_mode(fe_backend, carry_mode)
+    key = (mesh, B, S, fe_backend, carry_mode, reduce)
     with _cache_mtx:
         fn = _step_cache.get(key)
         if fn is not None:
             return fn, False
-        step = _fc.trace_with_backend(_k, _planner_step, fe_backend)
+        body = _planner_step_lanes if reduce == "host" else _planner_step
+        step = _fc.trace_with_modes(_k, body, fe_backend, carry_mode)
         if mesh is None:
             fn = jax.jit(step)
         else:
@@ -350,11 +534,27 @@ def _compiled_step(mesh, B: int, S: int, fe_backend: str = "vpu"):
             fn = jax.jit(
                 step,
                 in_shardings=(lane,) * 10 + (rep,),
-                out_shardings=(lane, rep, rep, rep),
+                out_shardings=(
+                    lane if reduce == "host" else (lane, rep, rep, rep)
+                ),
             )
         _step_cache[key] = fn
         _compiles += 1
         return fn, True
+
+
+def _host_reduce(plan: WindowPlan, ok_l: np.ndarray):
+    """Fold the lane verdicts into per-height int64 tallies on host — the
+    exact integer math the device segment_sum does, minus the collective.
+    Every dispatched lane [:n] is a vote, so nbad per height is simply the
+    count of its failed lanes."""
+    tally = np.zeros((plan.H,), dtype=np.int64)
+    nbad = np.zeros((plan.H,), dtype=np.int64)
+    if plan.n_lanes:
+        np.add.at(tally, plan.seg_ids[ok_l], plan.powers[ok_l])
+        np.add.at(nbad, plan.seg_ids[~ok_l], 1)
+    committed = tally * 3 > plan.totals * 2
+    return tally, committed, nbad
 
 
 def _execute_device(plan: WindowPlan, mesh=None) -> WindowVerdict:
@@ -366,12 +566,15 @@ def _execute_device(plan: WindowPlan, mesh=None) -> WindowVerdict:
     from tendermint_tpu.crypto.batch import _resolve_fe_backend
 
     fe_backend = _resolve_fe_backend(None)
-    fn, compiled = _compiled_step(mesh, B, S, fe_backend)
+    carry_mode = _resolve_carry_mode(fe_backend)
+    reduce = _reduce_mode
+    fn, compiled = _compiled_step(
+        mesh, B, S, fe_backend, carry_mode, reduce)
     t0 = time.perf_counter()
     backend = "planner_mesh" if mesh is not None else "planner"
     with trace.span(
         "planner.dispatch", backend=backend, H=plan.H, lanes=B, n=n,
-        compiled=compiled,
+        windows=plan.n_windows, compiled=compiled,
     ):
         # int64 powers: same consensus-safety reasoning as commit_verify —
         # without x64 the tally silently wraps at 2^31
@@ -386,12 +589,17 @@ def _execute_device(plan: WindowPlan, mesh=None) -> WindowVerdict:
                 arrs = [jax.device_put(a, lane) for a in arrs[:-1]] + [
                     jax.device_put(arrs[-1], rep)
                 ]
-            ok_l, tally, committed, nbad = fn(*arrs)
-            ok_l = np.asarray(ok_l)[:n]
-            tally = np.asarray(tally)[: plan.H]
-            committed = np.asarray(committed)[: plan.H]
-            nbad = np.asarray(nbad)[: plan.H]
+            if reduce == "host":
+                ok_l = np.asarray(fn(*arrs))[:n]
+                tally, committed, nbad = _host_reduce(plan, ok_l)
+            else:
+                ok_l, tally, committed, nbad = fn(*arrs)
+                ok_l = np.asarray(ok_l)[:n]
+                tally = np.asarray(tally)[: plan.H]
+                committed = np.asarray(committed)[: plan.H]
+                nbad = np.asarray(nbad)[: plan.H]
     dt = time.perf_counter() - t0
+    n_devices = int(mesh.devices.size) if mesh is not None else 1
     try:
         m = get_verify_metrics()
         m.record_planner(n, B, compiled=compiled)
@@ -402,7 +610,15 @@ def _execute_device(plan: WindowPlan, mesh=None) -> WindowVerdict:
             rejects=int(np.count_nonzero(plan.dev[6][:n] & ~ok_l)),
             first=compiled,
             fe_backend=fe_backend,
+            carry_mode=carry_mode,
         )
+        if mesh is not None:
+            m.record_device_shards(
+                (d.id for d in mesh.devices.flat), B // n_devices)
+        else:
+            import jax
+
+            m.record_device_shards((jax.devices()[0].id,), B)
         get_profiler().record(
             backend,
             bucket=(B, S),
@@ -414,6 +630,9 @@ def _execute_device(plan: WindowPlan, mesh=None) -> WindowVerdict:
             compiled=compiled,
             bytes_to_device=sum(a.nbytes for a in plan.dev),
             fe_backend=fe_backend,
+            carry_mode=carry_mode,
+            n_windows=plan.n_windows,
+            n_devices=n_devices,
         )
     except Exception:
         pass
@@ -485,6 +704,7 @@ def _execute_host(plan: WindowPlan, verifier=None) -> WindowVerdict:
             heights=plan.H,
             pack_seconds=plan.pack_seconds,
             run_seconds=time.perf_counter() - t0,
+            n_windows=plan.n_windows,
         )
     except Exception:
         pass
@@ -670,6 +890,56 @@ def verify_window(
     return execute_plan(plan, mesh=mesh, verifier=verifier, use_device=use_device)
 
 
+def _plan_and_execute_windows(
+    specs: Sequence[Tuple[Sequence, Sequence, Sequence]],
+    mesh=None,
+    verifier=None,
+    use_device: Optional[bool] = None,
+) -> Tuple[WindowPlan, WindowVerdict]:
+    """Superdispatch plumbing shared by verify_windows and LaneFeed: pack
+    every spec into one lane tile, run it through execute_plan (the SAME
+    guarded path single windows take — breaker, deadline, retry, audit and
+    host fallback all engage per superdispatch), return plan + combined
+    verdict."""
+    t0 = time.perf_counter()
+    with trace.span(
+        "planner.pack",
+        H=sum(len(v) for v, _, _ in specs),
+        windows=len(specs),
+    ):
+        plan = plan_windows(specs)
+        if (use_device or (use_device is None and mesh is not None)) and (
+            plan.all_ed25519()
+        ):
+            pack_device(plan, mesh)
+    plan.pack_seconds = time.perf_counter() - t0
+    verdict = execute_plan(
+        plan, mesh=mesh, verifier=verifier, use_device=use_device)
+    return plan, verdict
+
+
+def verify_windows(
+    specs: Sequence[Tuple[Sequence, Sequence, Sequence]],
+    mesh=None,
+    verifier=None,
+    use_device: Optional[bool] = None,
+) -> List[WindowVerdict]:
+    """Verify several independent windows in ONE superdispatch.
+
+    Each spec is a `(votes, powers, totals)` triple as `verify_window`
+    takes them; the returned list is index-aligned with `specs` and each
+    verdict is bit-identical to `verify_window(*spec)` on the flat host
+    path.  One lane tile, one compile bucket, one guarded dispatch — this
+    is how many small windows (RPC commit bursts, frontend rows, backfill
+    tails) stop paying a whole padded bucket each."""
+    specs = list(specs)
+    if not specs:
+        return []
+    plan, verdict = _plan_and_execute_windows(
+        specs, mesh=mesh, verifier=verifier, use_device=use_device)
+    return split_verdict(plan, verdict)
+
+
 def rows_from_commit(precommits, pubkeys, msgs, sigs, powers):
     """Adapt `ValidatorSet.collect_commit_sigs` outputs (aligned, non-nil
     precommits in index order) into one planner row — shared by fast sync
@@ -697,18 +967,30 @@ class WindowPipeline:
     """Overlap host packing with device dispatch across a stream of windows.
 
     A daemon worker thread runs `plan_window` + `pack_device` (SHA-512,
-    point decompression, limb packing — the measured host slice) for window
-    N+1 while the consumer's dispatch for window N is in flight; a bounded
-    queue keeps at most `prefetch` packed windows in memory.  Exceptions
-    from the spec iterator or the packer re-raise at the consuming side, in
-    order, so callers keep their normal error handling."""
+    point decompression, limb packing — the measured host slice) for
+    windows N+1..N+depth while the consumer's dispatch for window N is in
+    flight; a bounded queue keeps at most `depth` packed windows in
+    memory.  Depth > 2 keeps the chips fed when pack time fluctuates
+    (mixed window sizes) — the default comes from `[verify]
+    pipeline_depth` via configure_planner.  Exceptions from the spec
+    iterator or the packer re-raise at the consuming side, in order, so
+    callers keep their normal error handling."""
 
     def __init__(self, mesh=None, verifier=None,
-                 use_device: Optional[bool] = None, prefetch: int = 2):
+                 use_device: Optional[bool] = None,
+                 prefetch: Optional[int] = None,
+                 depth: Optional[int] = None):
         self.mesh = mesh
         self.verifier = verifier
         self.use_device = use_device
-        self.prefetch = max(1, prefetch)
+        # `depth` is the configured name; `prefetch` stays as the original
+        # spelling for existing callers — both mean the same bound
+        d = depth if depth is not None else prefetch
+        self.prefetch = max(1, int(d) if d is not None else _pipeline_depth)
+
+    @property
+    def depth(self) -> int:
+        return self.prefetch
 
     def _execute_one(self, plan: WindowPlan) -> WindowVerdict:
         """One window's dispatch.  A device-path exception that somehow
@@ -850,9 +1132,14 @@ class LaneFeed:
     serves many concurrent callers each holding ONE row (a commit's
     lanes).  `submit()` parks the row for at most `window_s` seconds; a
     daemon worker folds every row that arrived meanwhile into one
-    lane-packed `verify_window` dispatch (same pack/dispatch trace spans,
-    same breaker + host-fallback guard) and hands each caller its row's
-    verdict slice.  This is the aggregation seam the light-client
+    lane-packed superdispatch (same pack/dispatch trace spans, same
+    breaker + host-fallback guard) and hands each caller its row's
+    verdict slice.  Rows beyond `max_rows` do NOT queue a second dispatch
+    behind the first any more: the worker chunks everything pending into
+    `max_rows`-row windows and `plan_windows` folds those into ONE lane
+    tile — racing flushes inside the deadline window ride together
+    (`windows_out` counts the folded windows, `dispatches` the actual
+    device round-trips).  This is the aggregation seam the light-client
     frontend feeds — the deadline-bounded micro-batch shape the
     mempool's CheckTx batching proved."""
 
@@ -868,8 +1155,11 @@ class LaneFeed:
         self.profile_kind = profile_kind
         self.on_flush = on_flush  # (verdict, n_rows, seconds) per flush
         # observability for tests/benches: rows_in counts every submitted
-        # row, dispatches every flush — their ratio is the realized batch
+        # row, dispatches every flush — their ratio is the realized batch;
+        # windows_out counts the ≤max_rows windows folded into those
+        # dispatches (windows_out > dispatches == superdispatch folding)
         self.dispatches = 0
+        self.windows_out = 0
         self.rows_in = 0
         self.lanes_in = 0
         self._cond = threading.Condition()
@@ -923,8 +1213,12 @@ class LaneFeed:
                         return
                     self._cond.wait(0.1)
                 # deadline-bounded collection: hold the batch open for the
-                # remainder of the window unless it filled (or closed) first
-                while len(self._pending) < self.max_rows and not self._closed:
+                # remainder of the window unless a full superdispatch's
+                # worth of rows (or close) arrived first — racing flushes
+                # inside the window fold into one dispatch, they don't
+                # queue behind each other
+                cap = self.max_rows * windows_per_dispatch(self.mesh)
+                while len(self._pending) < cap and not self._closed:
                     left = self._deadline - time.monotonic()
                     if left <= 0:
                         break
@@ -933,21 +1227,32 @@ class LaneFeed:
             self._flush(batch)
 
     def _flush(self, batch: List[tuple]) -> None:
-        votes = [b[0] for b in batch]
-        powers = [b[1] for b in batch]
-        totals = [b[2] for b in batch]
+        # chunk everything pending into ≤max_rows windows and fold the
+        # chunks into ONE superdispatch — one lane tile, one guarded
+        # device round-trip, however many flushes raced into this window
+        chunks = [
+            batch[i: i + self.max_rows]
+            for i in range(0, len(batch), self.max_rows)
+        ]
+        specs = [
+            ([b[0] for b in chunk], [b[1] for b in chunk],
+             [b[2] for b in chunk])
+            for chunk in chunks
+        ]
         t0 = time.perf_counter()
         try:
-            verdict = verify_window(
-                votes, powers, totals, mesh=self.mesh, verifier=self.verifier,
+            plan, verdict = _plan_and_execute_windows(
+                specs, mesh=self.mesh, verifier=self.verifier,
                 use_device=self.use_device,
             )
+            parts = split_verdict(plan, verdict)
         except BaseException as e:
             for _, _, _, ticket in batch:
                 ticket._resolve(err=e)
             return
         seconds = time.perf_counter() - t0
         self.dispatches += 1
+        self.windows_out += len(chunks)
         try:
             get_profiler().record(
                 self.profile_kind,
@@ -955,6 +1260,7 @@ class LaneFeed:
                 lanes_dispatched=verdict.lanes_dispatched,
                 heights=len(batch),
                 run_seconds=seconds,
+                n_windows=len(chunks),
             )
         except Exception:
             pass
@@ -963,13 +1269,15 @@ class LaneFeed:
                 self.on_flush(verdict, len(batch), seconds)
             except Exception:
                 pass
-        for i, (vrow, _, _, ticket) in enumerate(batch):
-            ticket._resolve(RowVerdict(
-                ok=np.asarray(verdict.ok[i, : len(vrow)], dtype=bool),
-                tally=int(verdict.tally[i]),
-                committed=bool(verdict.committed[i]),
-                sigs_ok=bool(verdict.sigs_ok[i]),
-                batch_rows=len(batch),
-                batch_lanes=verdict.lanes_present,
-                occupancy=verdict.occupancy,
-            ))
+        for ci, chunk in enumerate(chunks):
+            part = parts[ci]
+            for i, (vrow, _, _, ticket) in enumerate(chunk):
+                ticket._resolve(RowVerdict(
+                    ok=np.asarray(part.ok[i, : len(vrow)], dtype=bool),
+                    tally=int(part.tally[i]),
+                    committed=bool(part.committed[i]),
+                    sigs_ok=bool(part.sigs_ok[i]),
+                    batch_rows=len(batch),
+                    batch_lanes=verdict.lanes_present,
+                    occupancy=verdict.occupancy,
+                ))

@@ -397,8 +397,12 @@ class StateSyncer:
                     totals.append(fc.validators.total_voting_power())
                 yield votes_rows, power_rows, totals
 
+        # depth > 2 ([verify] pipeline_depth) keeps packing sub-windows
+        # ahead while earlier dispatches are in flight, so the mesh never
+        # idles between ragged sub-windows
         pipe = planner.WindowPipeline(
-            mesh=self.mesh, verifier=self.batch_verifier, use_device=True
+            mesh=self.mesh, verifier=self.batch_verifier, use_device=True,
+            depth=planner.pipeline_depth(),
         )
         from tendermint_tpu.libs.profile import get_profiler
 

@@ -157,6 +157,30 @@ class TestLaneFeed:
         with pytest.raises(RuntimeError, match="closed"):
             feed.submit(_signed_row(1, 7), [1], 1)
 
+    def test_racing_flushes_fold_into_one_superdispatch(self):
+        """Regression: rows beyond max_rows used to queue a SECOND dispatch
+        behind the first.  Now the worker chunks everything pending into
+        ≤max_rows windows and plan_windows folds the chunks into ONE lane
+        tile — one device round-trip however many flushes raced."""
+        feed = LaneFeed(window_s=0.5, max_rows=4, use_device=False)
+        rows = [_signed_row(3, 40 + i) for i in range(11)]
+        serial = [
+            verify_window([row], [[1] * 3], [3], use_device=False)
+            for row in rows
+        ]
+        tickets = [feed.submit(row, [1] * 3, 3) for row in rows]
+        got = [t.result(30.0) for t in tickets]
+        feed.close()
+        # 11 rows > max_rows=4, all inside one deadline window: 3 folded
+        # windows, ONE dispatch
+        assert feed.dispatches == 1
+        assert feed.windows_out == 3
+        for want, have in zip(serial, got):
+            assert np.array_equal(np.asarray(want.ok[0]), have.ok)
+            assert int(want.tally[0]) == have.tally
+            assert bool(want.committed[0]) == have.committed
+            assert have.batch_rows == len(rows)
+
 
 # ---------------------------------------------------------------------------
 # HeaderCache + SingleFlight primitives

@@ -1,0 +1,291 @@
+"""Multi-window mesh superdispatch (parallel/planner.py): bit-identity of
+`verify_windows` on a forced 8-device CPU mesh vs the flat single-window
+host path, compile-bucket sharing across mixed-size streams, host- vs
+device-side tally reduction, pipeline depth > 2, and the PR-9 device
+guard wrapping the new dispatch shape unchanged."""
+
+import numpy as np
+import pytest
+
+from tendermint_tpu.libs import breaker as brk
+from tendermint_tpu.parallel import planner
+
+
+@pytest.fixture(autouse=True)
+def _planner_defaults():
+    brk.reset_device_guard()
+    # the first mesh dispatch per bucket compiles under the guard; don't
+    # let the default 30s deadline misread jit latency as a hung device
+    brk.configure_device_guard(dispatch_deadline=600.0)
+    yield
+    planner.configure_planner(None)
+    planner.set_device_executor(None)
+    brk.reset_device_guard()
+
+
+@pytest.fixture(scope="module")
+def mesh8():
+    import jax
+    from jax.sharding import Mesh
+
+    devs = jax.devices()
+    if len(devs) < 8:
+        pytest.skip("needs 8 forced host devices (conftest XLA_FLAGS)")
+    return Mesh(np.asarray(devs[:8]), ("lanes",))
+
+
+def _signed(n, tag=0):
+    from tendermint_tpu.crypto import ed25519 as ed
+
+    out = []
+    for i in range(n):
+        seed = bytes([(i % 251) + 1, (i // 251) + 1, (tag % 250) + 1]) * 16
+        priv = ed.gen_privkey(seed[:32])
+        msg = b"multichip-%d-%d" % (tag, i)
+        out.append((priv[32:], msg, ed.sign(priv, msg)))
+    return out
+
+
+def _window(sizes, tag=0, absent=(), forged=(), totals=None):
+    """One (votes, powers, totals) window spec; power 1 per lane so the
+    strict +2/3 boundary is steered by an explicit `totals` override."""
+    triples = _signed(sum(sizes), tag=tag)
+    votes, powers, tot = [], [], []
+    i = 0
+    for h, V in enumerate(sizes):
+        vrow = []
+        for v in range(V):
+            pub, msg, sig = triples[i]
+            i += 1
+            if (h, v) in absent:
+                vrow.append(None)
+            elif (h, v) in forged:
+                bad = bytearray(sig)
+                bad[9] ^= 1
+                vrow.append((pub, msg, bytes(bad)))
+            else:
+                vrow.append((pub, msg, sig))
+        votes.append(vrow)
+        powers.append([1] * V)
+        tot.append(V)
+    return votes, powers, list(totals) if totals is not None else tot
+
+
+def _assert_same_verdict(got, want):
+    assert got.ok.shape == want.ok.shape
+    assert np.array_equal(got.ok, want.ok)
+    assert got.tally.dtype == np.int64
+    assert np.array_equal(got.tally, want.tally)
+    assert np.array_equal(got.committed, want.committed)
+    assert np.array_equal(got.sigs_ok, want.sigs_ok)
+
+
+def _matrix_specs():
+    """The acceptance matrix: ragged valsets 1/4/64, absence, forgery, and
+    a strict-boundary window where tally*3 == totals*2 exactly (must NOT
+    commit)."""
+    return [
+        _window([1], tag=1),
+        _window([4], tag=2, forged={(0, 3)}),
+        # 2 valid of total 3 → 6 > 6 is false: the strict boundary
+        _window([2], tag=3, totals=[3]),
+        _window([64], tag=4),
+        _window([2, 3], tag=5, absent={(1, 0)}),
+    ]
+
+
+class TestMeshSuperdispatch:
+    @pytest.mark.parametrize("reduce_mode", ["device", "host"])
+    def test_bit_identical_to_flat_host_path(self, mesh8, reduce_mode):
+        specs = _matrix_specs()
+        flat = [planner.verify_window(*s, use_device=False) for s in specs]
+        planner.set_reduce_mode(reduce_mode)
+        try:
+            got = planner.verify_windows(specs, mesh=mesh8, use_device=True)
+        finally:
+            planner.set_reduce_mode("device")
+        assert len(got) == len(flat)
+        for g, w in zip(got, flat):
+            _assert_same_verdict(g, w)
+        # the verdicts came from the mesh, not from a silent guard
+        # fallback — PR-9's breaker saw a clean dispatch
+        snap = brk.get_device_breaker().snapshot()
+        assert snap["failures_total"] == 0
+        assert brk.get_device_breaker().state == brk.CLOSED
+
+    def test_mixed_key_windows_split_on_host_path(self, mesh8):
+        """Windows holding secp256k1/multisig lanes can't ride the lane
+        kernel — the superdispatch must still serve them (verifier
+        boundary) with per-window verdicts identical to flat calls."""
+        from tendermint_tpu.crypto.keys import PrivKeyEd25519, PrivKeySecp256k1
+
+        sk = [PrivKeySecp256k1.from_secret(bytes([i + 9]) * 32)
+              for i in range(2)]
+        edp = [PrivKeyEd25519.generate(bytes([i + 1]) * 32) for i in range(3)]
+        m0, m1 = b"mc-mixed-0", b"mc-mixed-1"
+        specs = [
+            _window([3], tag=6),
+            ([[ (p.pub_key(), m0, p.sign(m0)) for p in sk ]], [[1, 1]], [2]),
+            ([[ (p.pub_key(), m1, p.sign(m1)) for p in edp ]
+              + [(sk[0].pub_key(), m1, sk[0].sign(m1))]], [[1] * 4], [4]),
+        ]
+        flat = [planner.verify_window(*s, use_device=False) for s in specs]
+        got = planner.verify_windows(specs, mesh=mesh8, use_device=True)
+        for g, w in zip(got, flat):
+            _assert_same_verdict(g, w)
+
+    def test_one_compile_per_bucket_across_mixed_stream(self, mesh8):
+        """Superdispatches of differing window counts/widths that land in
+        the same (lane, seg) bucket must share ONE mesh compile."""
+        c0 = planner.compile_count()
+        streams = [
+            [_window([40], tag=10), _window([30], tag=11)],
+            [_window([65], tag=12), _window([4], tag=13),
+             _window([8], tag=14)],
+            [_window([20, 20], tag=15), _window([25], tag=16),
+             _window([25], tag=17)],
+        ]
+        for specs in streams:
+            got = planner.verify_windows(specs, mesh=mesh8, use_device=True)
+            for g in got:
+                assert g.committed.all() and g.sigs_ok.all()
+        # 65..128 lanes, ≤8 heights → all three share the (128, 8) bucket
+        assert planner.compile_count() - c0 <= 1
+
+    def test_guard_wraps_superdispatch_per_dispatch(self, mesh8):
+        """A dead device executor must fall back to a bit-identical host
+        verdict for EVERY window of the superdispatch, and the breaker
+        must record the failure (PR-9 guard, new dispatch shape)."""
+        specs = _matrix_specs()
+        flat = [planner.verify_window(*s, use_device=False) for s in specs]
+
+        def explode(plan, mesh):
+            raise RuntimeError("mesh dispatch crashed")
+
+        planner.set_device_executor(explode)
+        got = planner.verify_windows(specs, mesh=mesh8, use_device=True)
+        for g, w in zip(got, flat):
+            _assert_same_verdict(g, w)
+        assert brk.get_device_breaker().snapshot()["failures_total"] > 0
+
+    def test_corrupt_superdispatch_quarantines(self, mesh8):
+        """Seeded audit: a corrupted mesh verdict must be suppressed and
+        quarantine the breaker — same contract as single windows."""
+        brk.configure_device_guard(audit_sample_rate=1.0)
+        specs = [_window([3], tag=20), _window([2], tag=21)]
+        flat = [planner.verify_window(*s, use_device=False) for s in specs]
+
+        def corrupt(plan, mesh):
+            v = planner._execute_host(plan)
+            v.ok = np.array(v.ok, copy=True)
+            h, vv = int(plan.coords[0, 0]), int(plan.coords[0, 1])
+            v.ok[h, vv] = not v.ok[h, vv]
+            return v
+
+        planner.set_device_executor(corrupt)
+        got = planner.verify_windows(specs, mesh=mesh8, use_device=True)
+        for g, w in zip(got, flat):
+            _assert_same_verdict(g, w)
+        assert brk.get_device_breaker().state == brk.QUARANTINED
+
+
+class TestSplitVerdict:
+    def test_split_matches_flat_shapes_and_lane_accounting(self):
+        specs = _matrix_specs()
+        plan = planner.plan_windows(specs)
+        assert plan.n_windows == len(specs)
+        verdict = planner._execute_host(plan)
+        parts = planner.split_verdict(plan, verdict)
+        lanes = 0
+        for part, spec in zip(parts, specs):
+            flat = planner.verify_window(*spec, use_device=False)
+            _assert_same_verdict(part, flat)
+            assert part.lanes_present == flat.lanes_present
+            # the shared tile is attributed to every window
+            assert part.lanes_dispatched == verdict.lanes_dispatched
+            lanes += part.lanes_present
+        assert lanes == verdict.lanes_present
+
+    def test_empty_specs_and_single_window_degenerate(self):
+        assert planner.verify_windows([]) == []
+        with pytest.raises(ValueError):
+            planner.plan_windows([])
+        spec = _window([2], tag=30)
+        one = planner.verify_windows([spec], use_device=False)
+        _assert_same_verdict(
+            one[0], planner.verify_window(*spec, use_device=False))
+
+
+class TestPipelineDepth:
+    def test_depth_gt2_preserves_order(self):
+        specs = [_window([2, 1], tag=40 + i) for i in range(6)]
+        flat = [planner.verify_window(*s, use_device=False) for s in specs]
+        pipe = planner.WindowPipeline(use_device=False, depth=4)
+        assert pipe.depth == 4
+        got = list(pipe.run(iter(specs)))
+        assert len(got) == len(flat)
+        for g, w in zip(got, flat):
+            _assert_same_verdict(g, w)
+
+    def test_abandoned_deep_pipeline_releases_worker(self):
+        """Closing the consumer mid-stream at depth 4 must not leak the
+        pack worker or hang — same contract the depth-2 pipeline had."""
+        import threading
+        import time
+
+        specs = (_window([2], tag=50 + i) for i in range(64))
+        pipe = planner.WindowPipeline(use_device=False, depth=4)
+        gen = pipe.run(specs)
+        next(gen)
+        next(gen)
+        gen.close()
+        deadline = 50
+        while deadline and any(
+            t.name == "planner-pack" and t.is_alive()
+            for t in threading.enumerate()
+        ):
+            time.sleep(0.1)
+            deadline -= 1
+        assert deadline, "pack worker still alive after abandonment"
+
+    def test_configured_depth_flows_from_config(self):
+        from tendermint_tpu.config.config import VerifyConfig
+
+        cfg = VerifyConfig(
+            pipeline_depth=5, windows_per_device=2, planner_reduce="host")
+        planner.configure_planner(cfg)
+        assert planner.pipeline_depth() == 5
+        assert planner.reduce_mode() == "host"
+        pipe = planner.WindowPipeline(use_device=False)
+        assert pipe.depth == 5
+        planner.configure_planner(None)
+        assert planner.pipeline_depth() == 2
+        assert planner.reduce_mode() == "device"
+        with pytest.raises(ValueError):
+            planner.configure_planner(
+                VerifyConfig(planner_reduce="sideways"))
+
+    def test_windows_per_dispatch_scales_with_mesh(self, mesh8):
+        from tendermint_tpu.config.config import VerifyConfig
+
+        assert planner.windows_per_dispatch() == 4
+        assert planner.windows_per_dispatch(mesh8) == 32
+        planner.configure_planner(VerifyConfig(windows_per_device=2))
+        assert planner.windows_per_dispatch(mesh8) == 16
+
+
+class TestDeviceLabelMetrics:
+    def test_device_label_caps_and_folds_overflow(self):
+        from tendermint_tpu.libs.metrics import VerifyMetrics
+
+        vm = VerifyMetrics()
+        vm.record_device_shards(range(40), 8)
+        labels = {
+            k[0] for k in vm.device_dispatches._values
+        }
+        assert "overflow" in labels
+        assert len(labels) <= vm.MAX_DEVICE_LABELS + 1
+        # overflow absorbed every dispatch past the cap
+        assert vm.device_dispatches._values[("overflow",)] == 40 - vm.MAX_DEVICE_LABELS
+        # per-device lane attribution rode along
+        assert vm.device_lanes._values[("0",)] == 8.0
