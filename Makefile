@@ -4,7 +4,8 @@ PYTHON ?= python
 
 .PHONY: test test_slow test_sanitizers bench bench-local bench_fastsync \
         planner-bench pallas-bench bench_secp bench_multisig mempool-bench \
-        lite-bench multichip-bench metrics-lint bench-check statesync-smoke \
+        lite-bench multichip-bench vote-bench metrics-lint bench-check \
+        statesync-smoke \
         flight-smoke chaos-smoke \
         localnet-start localnet-stop build-docker-localnode
 
@@ -71,6 +72,14 @@ multichip-bench:
 	JAX_PLATFORMS=cpu $(PYTHON) scripts/bench_multichip.py $(ARGS)
 	$(PYTHON) scripts/bench_check.py --prefix MULTICHIP \
 	  --metric planner_windows_per_s:0.25:higher
+
+# live-vote micro-batcher: seeded vote storm through VoteSet.prevalidate +
+# VoteFeed vs the serial add_vote loop, bit-parity asserted; headline
+# metric is vote_verify_per_s (batched, 256 validators)
+vote-bench:
+	JAX_PLATFORMS=cpu $(PYTHON) scripts/bench_votes.py $(ARGS)
+	$(PYTHON) scripts/bench_check.py --prefix VOTES \
+	  --metric vote_verify_per_s:0.25:higher
 
 # strict text-format v0.0.4 self-check of Registry.expose_text(); pass files
 # to lint scrape snapshots: make metrics-lint ARGS="/tmp/m.prom"

@@ -51,6 +51,9 @@ DEFAULT_METRICS = [
     # multi-window mesh superdispatch headline (scripts/bench_multichip.py /
     # make multichip-bench — MULTICHIP_r*.json rounds via --prefix)
     "planner_windows_per_s:0.25:higher",
+    # live-vote micro-batcher headline (scripts/bench_votes.py /
+    # make vote-bench — VOTES_r*.json rounds via --prefix)
+    "vote_verify_per_s:0.25:higher",
 ]
 DEFAULT_THRESHOLD = 0.20
 

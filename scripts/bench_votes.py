@@ -1,0 +1,427 @@
+"""Live-vote micro-batcher bench: streaming VoteSet.add_vote, batched vs
+serial.
+
+Replays a seeded gossip storm — prevotes + precommits for two rounds,
+laced with re-gossiped duplicates, equivocations, mutated block ids and
+garbage signatures — through both vote paths:
+
+  * serial  — the reference loop: one ``VoteSet.add_vote`` per arriving
+    vote, each paying its own host signature verification.  This is what
+    every vote cost before the verification seam existed.
+  * batched — the streaming path: ``prevalidate`` splits the structural
+    checks off, the ``VoteFeed`` micro-batcher parks signatures for a few
+    ms and flushes them as ONE superdispatch through the planner (host
+    backend = the random-linear-combination ed25519 batch check), and the
+    verdict tickets re-enter ``add_vote(verified=True)`` in arrival
+    order.
+
+The storm arrives in WAVES, the way gossip actually delivers it: a
+re-gossiped duplicate or a mutated copy of a vote trails the original by
+a propagation delay, so by the time it arrives the original is already
+tallied and prevalidation rejects it without ever reaching a verifier —
+on BOTH paths.  Each wave is applied before the next is submitted.
+
+Bit-parity is asserted before any number is reported: outcome labels
+(added / duplicate / conflict / the exact VoteError class), minted
+evidence pairs, and the final state of every vote set (bit arrays,
+tallies, +2/3) must match the serial reference exactly.
+
+Devices are CPU streams forced via
+``XLA_FLAGS=--xla_force_host_platform_device_count=8`` so the bench runs
+anywhere; the headline batched number rides the production CPU-host
+default (the RLC host backend — on a chipless host that is what the
+guard lands every flush on), and ``--device-probe`` additionally pushes
+one storm through a mesh-backed feed for the device-path number.
+
+Writes the next ``VOTES_rNN.json`` round with a ``parsed`` dict;
+``make vote-bench`` runs this then gates ``vote_verify_per_s`` via
+``bench_check.py --prefix VOTES``.
+
+Usage: python scripts/bench_votes.py [--valcounts 16,64,256] [--reps 2]
+                                     [--waves 6] [--seed 7]
+                                     [--min-speedup 4.0] [--device-probe]
+                                     [--round-dir REPO_ROOT]
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+import time
+
+# device fan-out must be pinned BEFORE jax imports
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import random  # noqa: E402
+
+from tendermint_tpu.types import (  # noqa: E402
+    BlockID,
+    MockPV,
+    PartSetHeader,
+    SignedMsgType,
+    Validator,
+    ValidatorSet,
+    Vote,
+    VoteSet,
+)
+from tendermint_tpu.types.vote import (  # noqa: E402
+    ErrVoteConflictingVotes,
+    VoteError,
+)
+
+CHAIN_ID = "vote-bench-chain"
+TS = 1_700_000_000_000_000_000
+BLOCK_A = BlockID(hash=b"a" * 32,
+                  parts_header=PartSetHeader(total=1, hash=b"p" * 32))
+BLOCK_B = BlockID(hash=b"b" * 32,
+                  parts_header=PartSetHeader(total=1, hash=b"p" * 32))
+ROUNDS = (0, 1)
+
+# seeded fault mix, cumulative rolls (the rest of the mass is honest-only):
+# 2% garbage signatures, 2% equivocations, 10% re-gossiped duplicates,
+# 2% mutated block ids carrying the original signature
+_GARBAGE, _EQUIV, _DUP, _MUTANT = 0.02, 0.04, 0.14, 0.16
+
+
+def make_vals(n, power=10):
+    from tendermint_tpu.crypto.keys import PrivKeyEd25519
+
+    pvs = [MockPV(PrivKeyEd25519.generate(bytes([i % 255 + 1, i // 255]) * 16))
+           for i in range(n)]
+    vs = ValidatorSet([Validator(pv.get_pub_key(), power) for pv in pvs])
+    by_addr = {pv.get_pub_key().address(): pv for pv in pvs}
+    return vs, [by_addr[v.address] for v in vs.validators]
+
+
+def make_vote(pv, vs, rnd, vtype, bid):
+    addr = pv.get_pub_key().address()
+    idx, _ = vs.get_by_address(addr)
+    vote = Vote(vote_type=vtype, height=1, round=rnd, timestamp_ns=TS,
+                block_id=bid, validator_address=addr, validator_index=idx)
+    return pv.sign_vote(CHAIN_ID, vote)
+
+
+def build_storm(vs, pvs, seed, waves):
+    """List of waves, each a shuffled [(group_key, vote)].  Every honest
+    vote lands in a random wave; its duplicates/mutants trail it by at
+    least one wave (gossip propagation delay), equivocations arrive any
+    time after, garbage arrives alongside."""
+    rng = random.Random(seed)
+    out = [[] for _ in range(waves)]
+    for rnd in ROUNDS:
+        for vtype in (SignedMsgType.PREVOTE, SignedMsgType.PRECOMMIT):
+            gk = (rnd, vtype)
+            for pv in pvs:
+                vote = make_vote(pv, vs, rnd, vtype, BLOCK_A)
+                w = rng.randrange(waves)
+                out[w].append((gk, vote))
+                roll = rng.random()
+                if roll < _GARBAGE:
+                    bad = vote.with_signature(
+                        bytes(rng.randrange(256) for _ in range(64)))
+                    out[w].append((gk, bad))
+                elif roll < _EQUIV:
+                    ev = make_vote(pv, vs, rnd, vtype, BLOCK_B)
+                    out[rng.randrange(w, waves)].append((gk, ev))
+                elif roll < _DUP:
+                    out[min(w + 1 + rng.randrange(2), waves - 1)].append(
+                        (gk, vote))
+                elif roll < _MUTANT:
+                    mut = make_vote(pv, vs, rnd, vtype, BLOCK_B).with_signature(
+                        vote.signature)
+                    out[min(w + 1, waves - 1)].append((gk, mut))
+    for wave in out:
+        rng.shuffle(wave)
+    return out
+
+
+def fresh_sets(vs):
+    return {
+        (rnd, vtype): VoteSet(CHAIN_ID, 1, rnd, vtype, vs)
+        for rnd in ROUNDS
+        for vtype in (SignedMsgType.PREVOTE, SignedMsgType.PRECOMMIT)
+    }
+
+
+def run_serial(sets, storm_waves):
+    """Reference path: per-vote add_vote, serial host verification."""
+    outcomes, evidence = [], []
+    for wave in storm_waves:
+        for gk, vote in wave:
+            vset = sets[gk]
+            try:
+                outcomes.append(("added", vset.add_vote(vote)))
+            except ErrVoteConflictingVotes as e:
+                outcomes.append(("conflict", e.added))
+                evidence.append((gk, e.vote_a, e.vote_b))
+            except VoteError as e:
+                outcomes.append((type(e).__name__, None))
+    return outcomes, evidence
+
+
+def run_batched(sets, storm_waves, feed, timeout=600.0):
+    """Streaming path: per wave, prevalidate + park every signature in the
+    feed, then apply the wave's verdict tickets in arrival order before
+    the next wave arrives."""
+    outcomes, evidence = [], []
+    pos = 0
+    for wave in storm_waves:
+        pending = []
+        for gk, vote in wave:
+            p = pos
+            pos += 1
+            vset = sets[gk]
+            try:
+                pv = vset.prevalidate(vote)
+            except VoteError as e:
+                outcomes.append((p, (type(e).__name__, None)))
+                continue
+            if pv is None:
+                outcomes.append((p, ("added", False)))
+                continue
+            ticket = feed.submit(
+                gk, pv.pub_key, vote.sign_bytes(vset.chain_id),
+                vote.signature, power=pv.voting_power,
+                total=vset.val_set.total_voting_power(),
+            )
+            pending.append((p, gk, vote, ticket))
+        # the wave is fully delivered and its verdicts are about to be
+        # applied — collapse the window instead of idling it out, exactly
+        # as the consensus state does for a quorum-completing vote
+        if pending:
+            feed.flush_now()
+        for p, gk, vote, ticket in pending:
+            vset = sets[gk]
+            if not ticket.result(timeout=timeout).ok:
+                # mirror consensus/state.py's verdict handler: re-prevalidate
+                # so structural rejections that materialized in flight surface
+                # the serial path's exact error class
+                try:
+                    if vset.prevalidate(vote) is None:
+                        outcomes.append((p, ("added", False)))
+                    else:
+                        outcomes.append((p, ("ErrVoteInvalidSignature", None)))
+                except VoteError as e:
+                    outcomes.append((p, (type(e).__name__, None)))
+                continue
+            try:
+                outcomes.append(
+                    (p, ("added", vset.add_vote(vote, verified=True))))
+            except ErrVoteConflictingVotes as e:
+                outcomes.append((p, ("conflict", e.added)))
+                evidence.append((gk, e.vote_a, e.vote_b))
+            except VoteError as e:
+                outcomes.append((p, (type(e).__name__, None)))
+    outcomes.sort()
+    return [o for _, o in outcomes], evidence
+
+
+def check_parity(n_vals, serial_sets, batched_sets, want, got, want_ev, got_ev):
+    """Outcome labels, evidence pairs and final vote-set state must match
+    the serial reference bit for bit — a wrong verdict must never post a
+    throughput number."""
+    if got != want:
+        for i, (a, b) in enumerate(zip(want, got)):
+            if a != b:
+                raise SystemExit(
+                    f"parity FAILED at {n_vals} vals, vote {i}: "
+                    f"serial={a} batched={b}")
+        raise SystemExit(f"parity FAILED at {n_vals} vals: outcome counts")
+    if sorted((gk, a.signature, b.signature) for gk, a, b in want_ev) != \
+            sorted((gk, a.signature, b.signature) for gk, a, b in got_ev):
+        raise SystemExit(f"parity FAILED at {n_vals} vals: evidence pairs")
+    for gk, s in serial_sets.items():
+        b = batched_sets[gk]
+        if not (s.bit_array() == b.bit_array() and s.sum == b.sum
+                and s.two_thirds_majority() == b.two_thirds_majority()):
+            raise SystemExit(f"parity FAILED at {n_vals} vals: state of {gk}")
+
+
+def _make_feed(mesh=None, use_device=False):
+    from tendermint_tpu.parallel.planner import VoteFeed
+
+    # window must outlast a wave's submit loop (prevalidate on one core is
+    # ~0.15ms/vote) or the tail of the wave lands in a runt second flush
+    return VoteFeed(mesh=mesh, use_device=use_device, window_s=0.05,
+                    max_rows=512)
+
+
+def _bench_config(vs, pvs, storm, reps):
+    """(serial votes/s, batched votes/s, n_votes, flush stats) for one
+    valcount — parity asserted on the first (warm) batched pass."""
+    n_votes = sum(len(w) for w in storm)
+
+    serial_sets = fresh_sets(vs)
+    want, want_ev = run_serial(serial_sets, storm)
+
+    feed = _make_feed()
+    try:
+        batched_sets = fresh_sets(vs)
+        got, got_ev = run_batched(batched_sets, storm, feed)
+    finally:
+        feed.close()
+        feed.join(30.0)
+    check_parity(len(pvs), serial_sets, batched_sets, want, got,
+                 want_ev, got_ev)
+
+    best_serial = float("inf")
+    for _ in range(reps):
+        sets = fresh_sets(vs)
+        t0 = time.perf_counter()
+        run_serial(sets, storm)
+        best_serial = min(best_serial, time.perf_counter() - t0)
+
+    best_batched = float("inf")
+    flushes = {}
+    for _ in range(reps):
+        feed = _make_feed()
+        try:
+            sets = fresh_sets(vs)
+            t0 = time.perf_counter()
+            run_batched(sets, storm, feed)
+            best_batched = min(best_batched, time.perf_counter() - t0)
+        finally:
+            feed.close()
+            feed.join(30.0)
+        flushes = dict(feed.flushes)
+        flushes["dispatches"] = feed.dispatches
+    return n_votes / best_serial, n_votes / best_batched, n_votes, flushes
+
+
+def _write_round(round_dir: str, parsed: dict, tail: str) -> str:
+    ns = [
+        int(m.group(1))
+        for p in glob.glob(os.path.join(round_dir, "VOTES_r*.json"))
+        if (m := re.search(r"VOTES_r(\d+)\.json$", os.path.basename(p)))
+    ]
+    path = os.path.join(round_dir, f"VOTES_r{max(ns, default=0) + 1:02d}.json")
+    with open(path, "w") as f:
+        json.dump({"rc": 0, "tail": tail, "parsed": parsed}, f, indent=2)
+        f.write("\n")
+    print(f"# bench round -> {path}", file=sys.stderr)
+    return path
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--valcounts", default="16,64,256")
+    p.add_argument("--reps", type=int, default=2,
+                   help="timed repetitions per config; best rep reported")
+    p.add_argument("--waves", type=int, default=6,
+                   help="gossip arrival waves the storm is split into")
+    p.add_argument("--seed", type=int, default=7)
+    p.add_argument("--min-speedup", type=float, default=4.0,
+                   help="required batched/serial ratio at the largest valcount")
+    p.add_argument("--device-probe", action="store_true",
+                   help="also push one storm through a mesh-backed feed and "
+                        "report the device-path rate (slow: pays jit compile)")
+    p.add_argument("--round-dir", default=os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))),
+        help="where VOTES_rNN.json rounds land ('' skips the round)")
+    args = p.parse_args()
+
+    valcounts = [int(s) for s in args.valcounts.split(",") if s]
+    print(json.dumps({
+        "stage": "fixture", "valcounts": valcounts, "waves": args.waves,
+        "rounds": len(ROUNDS), "seed": args.seed,
+    }), flush=True)
+
+    sweep = {}
+    for n_vals in valcounts:
+        vs, pvs = make_vals(n_vals)
+        storm = build_storm(vs, pvs, args.seed, args.waves)
+        serial_rate, batched_rate, n_votes, flushes = _bench_config(
+            vs, pvs, storm, args.reps)
+        sweep[n_vals] = {
+            "votes": n_votes,
+            "serial_votes_per_s": round(serial_rate, 2),
+            "batched_votes_per_s": round(batched_rate, 2),
+            "speedup": round(batched_rate / serial_rate, 2),
+            "flushes": flushes,
+        }
+        print(json.dumps({"stage": f"vals{n_vals}", **sweep[n_vals]}),
+              flush=True)
+
+    device_probe = None
+    if args.device_probe:
+        import numpy as np
+        import jax
+        from jax.sharding import Mesh
+
+        from tendermint_tpu.libs.breaker import configure_device_guard
+        from tendermint_tpu.parallel import planner
+
+        # first dispatch per bucket compiles; don't let the guard deadline
+        # misread jit latency as a hung device
+        configure_device_guard(dispatch_deadline=600.0)
+        planner.set_reduce_mode("host")
+        try:
+            mesh = Mesh(np.asarray(jax.devices()), ("lanes",))
+            n_vals = valcounts[-1]
+            vs, pvs = make_vals(n_vals)
+            storm = build_storm(vs, pvs, args.seed, args.waves)
+            n_votes = sum(len(w) for w in storm)
+            for rep in range(2):  # rep 0 warms the compile
+                feed = _make_feed(mesh=mesh, use_device=True)
+                try:
+                    sets = fresh_sets(vs)
+                    t0 = time.perf_counter()
+                    run_batched(sets, storm, feed)
+                    dt = time.perf_counter() - t0
+                finally:
+                    feed.close()
+                    feed.join(30.0)
+            device_probe = {
+                "valcount": n_vals,
+                "devices": len(jax.devices()),
+                "batched_votes_per_s": round(n_votes / dt, 2),
+            }
+            print(json.dumps({"stage": "device_probe", **device_probe}),
+                  flush=True)
+        finally:
+            planner.set_reduce_mode("device")
+            configure_device_guard()
+
+    top = max(valcounts)
+    headline = sweep[top]
+    parsed = {
+        "vote_verify_per_s": headline["batched_votes_per_s"],
+        "vote_verify_per_s_serial": headline["serial_votes_per_s"],
+        "vote_speedup": headline["speedup"],
+        "valcount": top,
+        "waves": args.waves,
+        "sweep": {str(n): sweep[n] for n in valcounts},
+        "device_probe": device_probe,
+        "parity": True,
+    }
+    tail = json.dumps({
+        "metric": "vote_verify_per_s",
+        "value": parsed["vote_verify_per_s"],
+        "unit": "votes/s",
+        **{k: parsed[k] for k in (
+            "vote_verify_per_s_serial", "vote_speedup", "valcount", "parity",
+        )},
+    })
+    print(tail, flush=True)
+    if args.round_dir:
+        _write_round(args.round_dir, parsed, tail)
+    if headline["speedup"] < args.min_speedup:
+        print(f"FAILED: speedup {headline['speedup']}x at {top} validators "
+              f"is below the {args.min_speedup}x floor", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

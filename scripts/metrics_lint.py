@@ -277,6 +277,7 @@ def _self_check():
         NodeMetrics,
         Registry,
         VerifyMetrics,
+        VoteBatchMetrics,
     )
 
     r = Registry()
@@ -325,6 +326,12 @@ def _self_check():
     fm.batch_rows.observe(8.0)
     fm.batch_occupancy.observe(0.75)
     fm.verify_seconds.observe(0.004)
+
+    vbm = VoteBatchMetrics()
+    # all three flush reasons must lint (the label drives the counter)
+    vbm.record_flush("deadline", 24, 64, 0.375)
+    vbm.record_flush("quorum", 3, 64, 0.047)
+    vbm.record_flush("close", 1, 8, 0.125)
 
     nm = NodeMetrics()
     # exercise the hot-path families so the lint covers sample lines, not
@@ -421,10 +428,30 @@ def _self_check():
             ("frontend-family parity",
              [f"missing family {n}" for n in missing_fe])
         )
+    # live-vote batcher family parity: VoteBatchMetrics owns the names
+    # ([verify] vote_batch_window_ms, parallel/planner.py VoteFeed) and
+    # NodeMetrics attaches the singleton registry into /metrics
+    vote_batch_names = (
+        "tendermint_consensus_vote_batch_rows",
+        "tendermint_consensus_vote_batch_lanes",
+        "tendermint_consensus_vote_batch_lane_occupancy",
+        "tendermint_consensus_vote_batch_flush_total",
+    )
+    vb_text = vbm.registry.expose_text()
+    missing_vb = [
+        n for n in vote_batch_names
+        if f"# TYPE {n} " not in vb_text or f"# TYPE {n} " not in node_text
+    ]
+    if missing_vb:
+        failures.append(
+            ("vote-batch family parity",
+             [f"missing family {n}" for n in missing_vb])
+        )
     for label, text in (
         ("escaping registry", r.expose_text()),
         ("VerifyMetrics", vm.registry.expose_text()),
         ("FrontendMetrics", frontend_text),
+        ("VoteBatchMetrics", vb_text),
         ("NodeMetrics(+verify attached)", node_text),
     ):
         errs = lint_text(text)

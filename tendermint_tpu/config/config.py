@@ -242,6 +242,15 @@ class VerifyConfig:
     # returns only lane-sharded verdicts and int64 tallies fold on host).
     # Bit-identical either way; "host" avoids the cross-device collective.
     planner_reduce: str = "device"
+    # live-vote micro-batcher (parallel/planner.VoteFeed): hold arriving
+    # consensus votes up to this many milliseconds and verify them as one
+    # lane-packed planner dispatch.  0 disables batching — every vote
+    # verifies serially on the host inside VoteSet.add_vote, the reference
+    # behavior.  Quorum-completing votes flush immediately regardless.
+    vote_batch_window_ms: float = 0.0
+    # vote-set rows per window of a vote-batch flush (windows fold into one
+    # superdispatch via plan_windows, windows_per_device applies)
+    vote_batch_rows: int = 64
 
 
 @dataclass

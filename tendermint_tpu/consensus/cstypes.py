@@ -82,9 +82,22 @@ class HeightVoteSet:
                     self._add_round(r)
             self.round = round
 
-    def add_vote(self, vote: Vote, peer_id: str = "") -> bool:
+    def add_vote(self, vote: Vote, peer_id: str = "",
+                 verified: bool = False) -> bool:
         """Raises VoteError subclasses; returns added.  Unknown rounds are
-        created lazily, at most 2 catchup rounds per peer."""
+        created lazily, at most 2 catchup rounds per peer.  `verified=True`
+        is the batched-verification seam: the signature already checked on
+        the device, so the VoteSet skips the per-vote host verify (structural
+        prevalidation still reruns)."""
+        with self._mtx:
+            vs = self.vote_set_for(vote, peer_id)
+            return vs.add_vote(vote, verified=verified)
+
+    def vote_set_for(self, vote: Vote, peer_id: str = "") -> VoteSet:
+        """Resolve (creating catchup rounds against the same 2-per-peer
+        budget `add_vote` enforces) the VoteSet this vote belongs to — the
+        vote micro-batcher prevalidates against it before submitting the
+        signature for batched verification."""
         with self._mtx:
             vs = self._get_vote_set(vote.round, vote.vote_type)
             if vs is None:
@@ -96,7 +109,7 @@ class HeightVoteSet:
                     self._peer_catchup_rounds[peer_id] = rounds
                 else:
                     raise GotVoteFromUnwantedRoundError()
-            return vs.add_vote(vote)
+            return vs
 
     def prevotes(self, round: int) -> Optional[VoteSet]:
         with self._mtx:

@@ -64,7 +64,11 @@ from tendermint_tpu.types.events import (
     EVENT_VALID_BLOCK,
     EventBus,
 )
-from tendermint_tpu.types.vote import ErrVoteConflictingVotes, VoteError
+from tendermint_tpu.types.vote import (
+    ErrVoteConflictingVotes,
+    ErrVoteInvalidSignature,
+    VoteError,
+)
 
 
 class ErrVoteHeightMismatch(VoteError):
@@ -133,6 +137,21 @@ class ConsensusState(BaseService):
         self.skip_wal_catchup = False  # set after fast sync (reactor.go:116)
         self._done = threading.Event()
 
+        # live-vote micro-batcher (parallel/planner.VoteFeed, wired by the
+        # node when [verify] vote_batch_window_ms > 0).  Peer votes that
+        # clear structural prevalidation park in the feed; a pump thread
+        # waits verdict tickets in submit order and re-enters them into
+        # the receive queue as 'vote_verdict' items, so batched votes
+        # apply on the consensus thread in arrival order.
+        self._vote_feed = None
+        # FIFO of (vote, peer_id, ticket, group_key, block_key, power)
+        self._vote_pump_q: "queue.Queue" = queue.Queue()
+        self._vote_pump_started = False
+        # power submitted-but-unresolved per ((h, r, type), block_key):
+        # the quorum-flush heuristic counts it toward +2/3 so a
+        # quorum-completing vote never waits out the deadline
+        self._vote_pending_power: dict = {}
+
         # test hooks (state.go:113-115, byzantine_test)
         self.decide_proposal: Callable = self._default_decide_proposal
         self.do_prevote: Callable = self._default_do_prevote
@@ -152,6 +171,21 @@ class ConsensusState(BaseService):
     def set_timeout_ticker(self, ticker) -> None:
         with self._mtx:
             self.timeout_ticker = ticker
+
+    def set_vote_feed(self, feed) -> None:
+        """Enable the vote micro-batcher: live peer votes verify through
+        `feed` (a planner VoteFeed) instead of serially inside add_vote.
+        Pass None to return to the serial path.  The caller owns the feed's
+        lifecycle (close it after stopping this service)."""
+        with self._mtx:
+            self._vote_feed = feed
+            if feed is not None and not self._vote_pump_started:
+                self._vote_pump_started = True
+                threading.Thread(
+                    target=self._vote_verdict_pump,
+                    name="consensus-vote-pump",
+                    daemon=True,
+                ).start()
 
     # getters ---------------------------------------------------------------
     def get_round_state(self) -> RoundState:
@@ -387,6 +421,10 @@ class ConsensusState(BaseService):
                     self._handle_timeout(payload, rs_snapshot)
                 elif kind == "txs":
                     self._handle_txs_available()
+                elif kind == "vote_verdict":
+                    # no WAL write: the vote was WAL-logged as a peer msg
+                    # when it arrived; this is its deferred verdict
+                    self._handle_vote_verdict(payload)
         except Exception:
             import traceback
 
@@ -419,7 +457,8 @@ class ConsensusState(BaseService):
                             msg.height, msg.round, peer_id, e,
                         )
                 elif isinstance(msg, VoteMessage):
-                    self._try_add_vote(msg.vote, peer_id)
+                    if not self._maybe_batch_vote(msg.vote, peer_id):
+                        self._try_add_vote(msg.vote, peer_id)
                 else:
                     self.logger.error("unknown msg type %r", type(msg))
             except (VoteError, ErrInvalidProposalPOLRound, ErrInvalidProposalSignature) as e:
@@ -900,9 +939,126 @@ class ConsensusState(BaseService):
                 self._try_finalize_commit(height)
         return added
 
-    def _try_add_vote(self, vote: Vote, peer_id: str) -> bool:
+    # ---------------------------------------------------- vote micro-batcher
+    def _maybe_batch_vote(self, vote: Vote, peer_id: str) -> bool:
+        """Route a live peer vote to the vote micro-batcher.  Returns True
+        when the vote was consumed by the batched path (submitted for
+        verification, or dropped as an exact duplicate); False sends it
+        down the serial path unchanged.  Raises the same VoteError
+        subclasses structural prevalidation raises serially — _handle_msg's
+        existing catch treats them identically either way.
+
+        Kept deliberately narrow: own votes (peer_id ""), WAL replay,
+        height mismatches and last-commit stragglers all stay serial, so
+        batching only ever defers the signature check of current-height
+        gossip — the hot path — and everything else is bit-identical by
+        construction."""
+        feed = self._vote_feed
+        if (
+            feed is None
+            or self.replay_mode
+            or peer_id == ""
+            or vote is None
+            or self.rs.votes is None
+            or vote.height != self.rs.height
+        ):
+            return False
+        # GotVoteFromUnwantedRoundError propagates exactly as it would from
+        # the serial rs.votes.add_vote (same call, same caller)
+        vs = self.rs.votes.vote_set_for(vote, peer_id)
+        pending = vs.prevalidate(vote)
+        if pending is None:
+            return True  # exact duplicate — serial add_vote returns False
+        gk = (vote.height, vote.round, int(vote.vote_type))
+        bk = vote.block_id.key()
+        power = pending.voting_power
+        in_flight = self._vote_pending_power.get((gk, bk), 0)
+        # flush immediately when this vote could complete the block's +2/3
+        # (counting power already submitted but unresolved) — a
+        # quorum-completing vote must never wait out the deadline
+        urgent = not vs.has_two_thirds_majority() and (
+            (vs.sum_by_block_id(vote.block_id) + in_flight + power) * 3
+            > vs.val_set.total_voting_power() * 2
+        )
+        self._vote_pending_power[(gk, bk)] = in_flight + power
         try:
-            return self._add_vote(vote, peer_id)
+            ticket = feed.submit(
+                gk,
+                pending.pub_key,
+                vote.sign_bytes(vs.chain_id),
+                vote.signature,
+                power=power,
+                total=vs.val_set.total_voting_power(),
+                urgent=urgent,
+            )
+        except Exception:
+            # feed closed/raced — undo the accounting, go serial
+            self._vote_pending_drop(gk, bk, power)
+            return False
+        self._vote_pump_q.put((vote, peer_id, ticket, gk, bk, power))
+        return True
+
+    def _vote_pending_drop(self, gk, bk, power: int) -> None:
+        left = self._vote_pending_power.get((gk, bk), 0) - power
+        if left > 0:
+            self._vote_pending_power[(gk, bk)] = left
+        else:
+            self._vote_pending_power.pop((gk, bk), None)
+
+    def _vote_verdict_pump(self) -> None:
+        """Waits batched-verdict tickets in submit (arrival) order and
+        re-enters each vote into the receive queue, so the consensus thread
+        applies batched votes FIFO just like serial ones."""
+        while not self.quit_event.is_set():
+            try:
+                item = self._vote_pump_q.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            vote, peer_id, ticket, gk, bk, power = item
+            try:
+                ok = bool(ticket.result(timeout=60.0).ok)
+            except BaseException:
+                # feed error/timeout: verdict unknown — the handler falls
+                # back to the serial (re-verifying) path, bit-identically
+                ok = None
+            self._queue.put(("vote_verdict", (vote, peer_id, ok, gk, bk, power)))
+
+    def _handle_vote_verdict(self, payload) -> None:
+        vote, peer_id, ok, gk, bk, power = payload
+        with self._mtx:
+            self._vote_pending_drop(gk, bk, power)
+            try:
+                if ok is None:
+                    # unknown verdict — serial re-verify, same as no batcher
+                    self._try_add_vote(vote, peer_id)
+                elif ok:
+                    # signature already paid on the batched dispatch;
+                    # structural prevalidation reruns inside add_vote so a
+                    # duplicate/conflict that raced in resolves identically
+                    self._try_add_vote(vote, peer_id, verified=True)
+                else:
+                    # failed the batched verify — but re-prevalidate first so
+                    # a structural rejection that materialized while the vote
+                    # was in flight surfaces the SAME error class the serial
+                    # path would have raised (e.g. a second differently-signed
+                    # vote for an already-tallied block is a non-deterministic
+                    # signature, not an invalid one)
+                    if (self.rs.votes is not None
+                            and vote.height == self.rs.height):
+                        vs = self.rs.votes.vote_set_for(vote, peer_id)
+                        if vs is not None and vs.prevalidate(vote) is None:
+                            return  # exact duplicate raced in — drop quietly
+                    raise ErrVoteInvalidSignature()
+            except (VoteError, ErrInvalidProposalPOLRound,
+                    ErrInvalidProposalSignature) as e:
+                self.logger.debug(
+                    "msg error h=%d r=%d: %s", self.rs.height, self.rs.round, e
+                )
+
+    def _try_add_vote(self, vote: Vote, peer_id: str,
+                      verified: bool = False) -> bool:
+        try:
+            return self._add_vote(vote, peer_id, verified=verified)
         except ErrVoteHeightMismatch:
             return False
         except ErrVoteConflictingVotes as e:
@@ -946,10 +1102,15 @@ class ConsensusState(BaseService):
             )
             self.metrics.vote_arrival_latency.observe(lat, (kind,))
 
-    def _add_vote(self, vote: Vote, peer_id: str) -> bool:
+    def _add_vote(self, vote: Vote, peer_id: str,
+                  verified: bool = False) -> bool:
         rs = self.rs
 
         # precommit straggler for the previous height (during NEW_HEIGHT wait)
+        # — deliberately NOT forwarding `verified`: a batched verdict was
+        # issued against the vote's own height, and if the height advanced
+        # between submit and verdict the cheap serial re-verify here keeps
+        # the last-commit path identical to a node without the batcher
         if vote.height + 1 == rs.height:
             if not (
                 rs.step == RoundStepType.NEW_HEIGHT
@@ -974,7 +1135,7 @@ class ConsensusState(BaseService):
             raise ErrVoteHeightMismatch()
 
         height = rs.height
-        added = rs.votes.add_vote(vote, peer_id)
+        added = rs.votes.add_vote(vote, peer_id, verified=verified)
         if not added:
             return False
         self._observe_vote_latency(vote)

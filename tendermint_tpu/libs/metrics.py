@@ -590,6 +590,59 @@ def get_frontend_metrics() -> FrontendMetrics:
         return _frontend_metrics
 
 
+class VoteBatchMetrics:
+    """Live-vote micro-batcher telemetry (parallel/planner.VoteFeed): how
+    many vote-set rows fold into each flush, how full the lane tile is, and
+    what triggered the flush (deadline|quorum|close).  Process-wide like
+    VerifyMetrics — the feed is one worker per process regardless of how
+    many vote sets feed it."""
+
+    def __init__(self, registry: Optional[Registry] = None):
+        r = registry or Registry()
+        self.registry = r
+        self.batch_rows = r.histogram(
+            "consensus_vote_batch_rows",
+            "Vote-set rows folded into one batched vote-verify dispatch",
+            buckets=_SIZE_BUCKETS,
+        )
+        self.batch_lanes = r.histogram(
+            "consensus_vote_batch_lanes",
+            "Votes (present lanes) per batched vote-verify dispatch",
+            buckets=_SIZE_BUCKETS,
+        )
+        self.lane_occupancy = r.histogram(
+            "consensus_vote_batch_lane_occupancy",
+            "Lane occupancy (present/dispatched) of batched vote dispatches",
+            buckets=(0.05, 0.1, 0.25, 0.5, 0.75, 0.9, 1.0),
+        )
+        self.flushes = r.counter(
+            "consensus_vote_batch_flush_total",
+            "Vote micro-batcher flushes by trigger (deadline|quorum|close)",
+            label_names=("reason",),
+        )
+
+    def record_flush(self, reason: str, rows: int, lanes: int,
+                     occupancy: float) -> None:
+        """One VoteFeed flush: shape + trigger in one call."""
+        self.batch_rows.observe(float(rows))
+        self.batch_lanes.observe(float(lanes))
+        self.lane_occupancy.observe(float(occupancy))
+        self.flushes.add(1.0, (reason,))
+
+
+_vote_batch_mtx = threading.Lock()
+_vote_batch_metrics: Optional[VoteBatchMetrics] = None
+
+
+def get_vote_batch_metrics() -> VoteBatchMetrics:
+    """Process-wide VoteBatchMetrics singleton (mirrors get_verify_metrics)."""
+    global _vote_batch_metrics
+    with _vote_batch_mtx:
+        if _vote_batch_metrics is None:
+            _vote_batch_metrics = VoteBatchMetrics()
+        return _vote_batch_metrics
+
+
 class NodeMetrics:
     """All four reference metric families on one registry
     (consensus/metrics.go:14, p2p/metrics.go, mempool/metrics.go,
@@ -744,6 +797,8 @@ class NodeMetrics:
         r.attach(self.statesync.registry)
         self.frontend = get_frontend_metrics()
         r.attach(self.frontend.registry)
+        self.vote_batch = get_vote_batch_metrics()
+        r.attach(self.vote_batch.registry)
         self._last_block_time: Optional[float] = None
         # cardinality hygiene: at most MAX_PEER_LABELS distinct peer ids ever
         # get their own label value; the rest collapse into "overflow"

@@ -17,10 +17,14 @@ with ``window(height_base)`` so entries can be grouped into a per-height
 ledger (`ledger()`), queryable via the unsafe-gated ``dump_profile`` RPC.
 
 Entry ``kind`` names the dispatch site: ``"device"`` / ``"host"`` from the
-planner's execute paths, and ``"frontend.verify_batch"`` for flushes of the
+planner's execute paths, ``"frontend.verify_batch"`` for flushes of the
 light-client frontend's cross-client aggregator (`parallel/planner.py
 LaneFeed` as wired by `frontend/frontend.py`) — there ``heights`` counts
-the client rows folded into the flush, not consecutive block heights.
+the client rows folded into the flush, not consecutive block heights —
+and ``"consensus.vote_batch"`` for flushes of the live-vote micro-batcher
+(`parallel/planner.VoteFeed`), where ``heights`` counts the vote-set rows
+the flush packed and ``n_windows`` the ≤max_rows windows folded into the
+superdispatch.
 
 Like libs/trace.py this is deliberately dependency-free and cheap when
 idle: recording is a dict append under a lock, and the ring buffer bounds

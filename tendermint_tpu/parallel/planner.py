@@ -1281,3 +1281,264 @@ class LaneFeed:
                     batch_lanes=verdict.lanes_present,
                     occupancy=verdict.occupancy,
                 ))
+
+
+# ---------------------------------------------------------------------------
+# Long-lived vote feed (live-consensus vote micro-batching)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class VoteVerdict:
+    """One submitted vote's outcome plus the shape of the dispatch that
+    served it (for the tendermint_consensus_vote_batch_* family)."""
+
+    ok: bool  # signature verified
+    batch_rows: int  # vote-set rows folded into the dispatch
+    batch_lanes: int  # present lanes (votes) in the dispatch
+    occupancy: float  # lane occupancy of the dispatch
+    flush_reason: str  # deadline | quorum | close
+
+
+class VoteTicket:
+    """Handle for one submitted vote; `result()` blocks until the feed's
+    worker flushes the batch the vote rode in."""
+
+    __slots__ = ("_ev", "_verdict", "_err")
+
+    def __init__(self):
+        self._ev = threading.Event()
+        self._verdict: Optional[VoteVerdict] = None
+        self._err: Optional[BaseException] = None
+
+    def _resolve(self, verdict=None, err=None) -> None:
+        self._verdict = verdict
+        self._err = err
+        self._ev.set()
+
+    def result(self, timeout: Optional[float] = None) -> VoteVerdict:
+        if not self._ev.wait(timeout):
+            raise TimeoutError("vote feed flush did not complete in time")
+        if self._err is not None:
+            raise self._err
+        return self._verdict
+
+
+class VoteFeed:
+    """`LaneFeed`'s sibling for LIVE consensus votes — the deadline-bounded
+    vote micro-batcher behind `VoteSet.add_vote`'s verification seam.
+
+    Where the lane feed's unit of submission is a whole row (one commit's
+    lanes), the vote feed's unit is a single vote: gossip delivers
+    prevotes/precommits one at a time, and `submit()` parks each for at
+    most `window_s` seconds.  Votes are keyed by their vote set — the
+    `(height, round, vote_type)` group whose valset they share — and each
+    group becomes ONE lane row of the flush, so concurrent vote sets (two
+    rounds in flight, prevotes + precommits) ride the same superdispatch.
+    Groups chunk into ≤max_rows-row windows and `plan_windows` folds the
+    chunks into one lane tile — the PR-9 breaker/deadline/audit/host-
+    fallback guard wraps the dispatch exactly as it wraps every other
+    planner window, and non-ed25519 lanes push the whole plan down the
+    host `verify_generic` path, bit-identically.
+
+    `flush_now()` collapses the deadline — the consensus state calls it
+    when a submitted vote could complete a +2/3 so a quorum never waits
+    out the window.  Flushes record their trigger (deadline|quorum|close)
+    into `tendermint_consensus_vote_batch_flush_total`."""
+
+    def __init__(self, mesh=None, verifier=None,
+                 use_device: Optional[bool] = None, window_s: float = 0.002,
+                 max_rows: int = 64,
+                 profile_kind: str = "consensus.vote_batch", on_flush=None):
+        self.mesh = mesh
+        if verifier is None:
+            # live-vote flushes default to the RLC host backend: one
+            # Pippenger MSM per clean flush instead of a serial loop, with
+            # accept/reject bit-identical to ed25519.verify.  This is the
+            # host side only — a mesh still rides the device kernel, and
+            # every guard fallback lands here.
+            from tendermint_tpu.crypto.batch import RLCHostVerifier
+
+            verifier = RLCHostVerifier()
+        self.verifier = verifier
+        self.use_device = use_device
+        self.window_s = max(0.0, float(window_s))
+        self.max_rows = max(1, int(max_rows))
+        self.profile_kind = profile_kind
+        self.on_flush = on_flush  # (reason, n_votes, n_rows, verdict, s)
+        # observability: votes_in counts submissions, rows_out the vote-set
+        # group rows they packed into, dispatches the device round-trips,
+        # windows_out the ≤max_rows windows folded into them
+        self.dispatches = 0
+        self.windows_out = 0
+        self.votes_in = 0
+        self.rows_out = 0
+        self.flushes: dict = {"deadline": 0, "quorum": 0, "close": 0}
+        self._cond = threading.Condition()
+        # (group_key, pub, msg, sig, power, total, ticket)
+        self._pending: List[tuple] = []
+        self._deadline = 0.0
+        self._urgent = False
+        self._closed = False
+        self._thread: Optional[threading.Thread] = None
+
+    def submit(
+        self,
+        group_key,
+        pub,
+        msg: bytes,
+        sig: bytes,
+        power: int = 1,
+        total: int = 1,
+        urgent: bool = False,
+    ) -> VoteTicket:
+        """Park one vote for the next flush; returns immediately.  Votes
+        sharing `group_key` (their vote set) pack into one lane row.
+        `urgent=True` collapses the window — the quorum-completing flush."""
+        ticket = VoteTicket()
+        with self._cond:
+            if self._closed:
+                raise RuntimeError("vote feed is closed")
+            if not self._pending:
+                self._deadline = time.monotonic() + self.window_s
+            self._pending.append(
+                (group_key, pub, bytes(msg), bytes(sig), int(power),
+                 int(total), ticket)
+            )
+            self.votes_in += 1
+            if urgent:
+                self._urgent = True
+            if self._thread is None:
+                self._thread = threading.Thread(
+                    target=self._worker, name="planner-vote-feed", daemon=True
+                )
+                self._thread.start()
+            self._cond.notify_all()
+        return ticket
+
+    def flush_now(self) -> None:
+        """Collapse the current deadline: pending votes dispatch at once
+        (counted as a quorum flush — the consensus caller's trigger)."""
+        with self._cond:
+            self._urgent = True
+            self._cond.notify_all()
+
+    def close(self) -> None:
+        """Stop accepting votes; pending votes still flush before the
+        worker exits (their tickets resolve, never hang)."""
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+
+    def join(self, timeout: Optional[float] = None) -> None:
+        """Wait for the worker to drain after close() — test hygiene."""
+        t = self._thread
+        if t is not None:
+            t.join(timeout)
+
+    def _worker(self) -> None:
+        while True:
+            with self._cond:
+                while not self._pending:
+                    if self._closed:
+                        return
+                    self._cond.wait(0.1)
+                # hold the batch open for the remainder of the window
+                # unless a quorum flush, close, or a full superdispatch's
+                # worth of votes arrived first
+                cap = self.max_rows * windows_per_dispatch(self.mesh)
+                while (
+                    len(self._pending) < cap
+                    and not self._closed
+                    and not self._urgent
+                ):
+                    left = self._deadline - time.monotonic()
+                    if left <= 0:
+                        break
+                    self._cond.wait(left)
+                if self._closed:
+                    reason = "close"
+                elif self._urgent:
+                    reason = "quorum"
+                else:
+                    reason = "deadline"
+                self._urgent = False
+                batch, self._pending = self._pending, []
+            self._flush(batch, reason)
+
+    def _flush(self, batch: List[tuple], reason: str) -> None:
+        # one lane row per vote-set group, in first-seen order; votes keep
+        # their lane position so verdicts map back per ticket
+        rows: List[tuple] = []  # (vrow, prow, total, tickets)
+        by_key: dict = {}
+        for group_key, pub, msg, sig, power, total, ticket in batch:
+            row = by_key.get(group_key)
+            if row is None:
+                row = ([], [], total, [])
+                by_key[group_key] = row
+                rows.append(row)
+            row[0].append((pub, msg, sig))
+            row[1].append(power)
+            row[3].append(ticket)
+        chunks = [
+            rows[i: i + self.max_rows]
+            for i in range(0, len(rows), self.max_rows)
+        ]
+        specs = [
+            ([r[0] for r in chunk], [r[1] for r in chunk],
+             [r[2] for r in chunk])
+            for chunk in chunks
+        ]
+        t0 = time.perf_counter()
+        try:
+            plan, verdict = _plan_and_execute_windows(
+                specs, mesh=self.mesh, verifier=self.verifier,
+                use_device=self.use_device,
+            )
+            parts = split_verdict(plan, verdict)
+        except BaseException as e:
+            for row in rows:
+                for ticket in row[3]:
+                    ticket._resolve(err=e)
+            return
+        seconds = time.perf_counter() - t0
+        self.dispatches += 1
+        self.windows_out += len(chunks)
+        self.rows_out += len(rows)
+        self.flushes[reason] = self.flushes.get(reason, 0) + 1
+        try:
+            get_profiler().record(
+                self.profile_kind,
+                lanes_present=verdict.lanes_present,
+                lanes_dispatched=verdict.lanes_dispatched,
+                heights=len(rows),
+                run_seconds=seconds,
+                n_windows=len(chunks),
+            )
+        except Exception:
+            pass
+        try:
+            from tendermint_tpu.libs.metrics import get_vote_batch_metrics
+
+            get_vote_batch_metrics().record_flush(
+                reason, rows=len(rows), lanes=verdict.lanes_present,
+                occupancy=verdict.occupancy,
+            )
+        except Exception:
+            pass
+        if self.on_flush is not None:
+            try:
+                self.on_flush(reason, len(batch), len(rows), verdict, seconds)
+            except Exception:
+                pass
+        for ci, chunk in enumerate(chunks):
+            part = parts[ci]
+            for ri, (vrow, _, _, tickets) in enumerate(chunk):
+                for j, ticket in enumerate(tickets):
+                    ticket._resolve(VoteVerdict(
+                        ok=bool(part.ok[ri, j]),
+                        batch_rows=len(rows),
+                        batch_lanes=verdict.lanes_present,
+                        occupancy=verdict.occupancy,
+                        flush_reason=reason,
+                    ))

@@ -130,6 +130,18 @@ class SimNode:
             cfg.consensus, st.copy(), block_exec, self.block_store,
             self.mempool, self.evpool, wal=wal,
         )
+        # [verify] vote_batch_window_ms > 0: batched live-vote verification
+        # (same wiring as node/node.py; exposed so scenarios can assert the
+        # feed actually engaged)
+        self.vote_feed = None
+        if getattr(cfg.verify, "vote_batch_window_ms", 0.0) > 0:
+            from tendermint_tpu.parallel.planner import VoteFeed
+
+            self.vote_feed = VoteFeed(
+                window_s=cfg.verify.vote_batch_window_ms / 1000.0,
+                max_rows=cfg.verify.vote_batch_rows,
+            )
+            self.cs.set_vote_feed(self.vote_feed)
         self.cs.set_event_bus(self.bus)
         self.cs.set_priv_validator(pv)
         self.cs.now_ns = self.clock
@@ -172,6 +184,11 @@ class SimNode:
             self.bus.stop()
         except Exception:
             pass
+        if self.vote_feed is not None:
+            try:
+                self.vote_feed.close()
+            except Exception:
+                pass
 
     def crash(self) -> None:
         """Kill the node mid-flight, keeping its durable state (state_db,

@@ -23,6 +23,7 @@ from tendermint_tpu.types.core import BlockID, SignedMsgType, is_vote_type_valid
 from tendermint_tpu.types.validator_set import ValidatorSet
 from tendermint_tpu.types.vote import (
     ErrVoteConflictingVotes,
+    ErrVoteInvalidSignature,
     ErrVoteInvalidValidatorAddress,
     ErrVoteInvalidValidatorIndex,
     ErrVoteNonDeterministicSignature,
@@ -33,6 +34,18 @@ from tendermint_tpu.types.vote import (
 
 class ErrVoteUnexpectedStep(VoteError):
     pass
+
+
+@dataclass(frozen=True)
+class PendingVote:
+    """A vote that cleared host-side structural prevalidation and now only
+    needs its signature checked.  `prevalidate` returns one of these; the
+    batched path ships (pub_key, sign_bytes, signature) to the planner and
+    applies the verdict with `add_vote(vote, verified=True)`."""
+
+    vote: Vote
+    pub_key: object
+    voting_power: int
 
 
 @dataclass
@@ -111,6 +124,18 @@ class VoteSet:
         idx, _ = self.val_set.get_by_address(address)
         return self.get_by_index(idx) if idx >= 0 else None
 
+    @property
+    def sum(self) -> int:
+        """Voting power in the main tally (one vote per validator)."""
+        return self._sum
+
+    def sum_by_block_id(self, block_id: BlockID) -> int:
+        """Tallied power for one block — the quorum-flush heuristic of the
+        vote micro-batcher asks whether a pending vote could complete this
+        block's +2/3."""
+        bv = self._votes_by_block.get(block_id.key())
+        return bv.sum if bv is not None else 0
+
     def has_two_thirds_majority(self) -> bool:
         return self._maj23 is not None
 
@@ -130,9 +155,29 @@ class VoteSet:
         )
 
     # mutation -------------------------------------------------------------
-    def add_vote(self, vote: Optional[Vote]) -> bool:
+    def add_vote(self, vote: Optional[Vote], verified: bool = False) -> bool:
         """Returns True if the vote was added; raises VoteError subclasses on
-        invalid/conflicting votes (ref vote_set.go:131-291)."""
+        invalid/conflicting votes (ref vote_set.go:131-291).
+
+        `verified=True` skips the signature check — the batched path already
+        paid it on the device (consensus/state.py's vote micro-batcher); the
+        structural prevalidation still reruns so a duplicate that raced in
+        between submit and verdict is rejected exactly like the serial path
+        would have rejected it."""
+        pending = self.prevalidate(vote)
+        if pending is None:
+            return False  # duplicate
+        if not verified:
+            vote.verify(self.chain_id, pending.pub_key)
+        return self._add_verified_vote(vote, pending.voting_power)
+
+    def prevalidate(self, vote: Optional[Vote]) -> Optional[PendingVote]:
+        """Everything `add_vote` decides BEFORE paying for signature
+        verification: index/address/step checks plus duplicate and
+        conflicting-signature dedup.  Returns None for an exact duplicate
+        (add_vote returns False), raises the same VoteError subclasses the
+        serial path raises, and otherwise hands back the (pub_key,
+        voting_power) the verification seam needs."""
         if vote is None:
             raise VoteError("nil vote")
         idx = vote.validator_index
@@ -154,15 +199,43 @@ class VoteSet:
 
         # dedup before paying for signature verification (ref getVote: checks
         # both the main tally and this block's tracker)
-        existing = self._get_vote(idx, vote.block_id.key())
+        key = vote.block_id.key()
+        existing = self._get_vote(idx, key)
         if existing is not None:
             if existing.signature == vote.signature:
-                return False  # duplicate
+                return None  # duplicate
             raise ErrVoteNonDeterministicSignature()
 
-        vote.verify(self.chain_id, val.pub_key)
+        # same signature under a DIFFERENT tracked block: the tracked copy
+        # already verified over its own sign bytes, and this vote's sign
+        # bytes differ, so one signature cannot cover both — reject now
+        # instead of paying a (batched) verification that must fail.
+        # Re-gossiped storms of mutated votes cost zero device rows.
+        if self._get_same_signature(idx, vote.signature, key) is not None:
+            raise ErrVoteInvalidSignature()
 
-        return self._add_verified_vote(vote, val.voting_power)
+        return PendingVote(vote=vote, pub_key=val.pub_key,
+                           voting_power=val.voting_power)
+
+    def _get_same_signature(
+        self, idx: int, signature: bytes, exclude_key: bytes
+    ) -> Optional[Vote]:
+        """A tracked vote by validator `idx` carrying `signature` for any
+        block OTHER than `exclude_key` (main tally + every block tracker)."""
+        existing = self._votes[idx]
+        if (
+            existing is not None
+            and existing.signature == signature
+            and existing.block_id.key() != exclude_key
+        ):
+            return existing
+        for k, bv in self._votes_by_block.items():
+            if k == exclude_key:
+                continue
+            tracked = bv.get_by_index(idx)
+            if tracked is not None and tracked.signature == signature:
+                return tracked
+        return None
 
     def _get_vote(self, idx: int, key: bytes) -> Optional[Vote]:
         existing = self._votes[idx]

@@ -2,6 +2,7 @@
 accept/reject edge cases, secp256k1, merkle, multisig, hashing."""
 
 import hashlib
+import random
 
 import pytest
 
@@ -127,6 +128,90 @@ class TestEd25519:
         # json round trip
         obj = pub.to_json_obj()
         assert pubkey_from_json_obj(obj).equals(pub)
+
+
+class TestEd25519Batch:
+    """ed.verify_batch must agree bit-for-bit with ed.verify — it is the
+    host backend behind the live-vote micro-batcher, so any divergence is
+    a consensus-safety bug, not a perf bug."""
+
+    def _fuzz_items(self, n, seed):
+        rng = random.Random(seed)
+        keys = [ed.gen_privkey(bytes([i + 1]) * 32) for i in range(8)]
+        items = []
+        for i in range(n):
+            k = keys[i % len(keys)]
+            msg = b"vote-%04d" % i
+            sig = ed.sign(k, msg)
+            roll = rng.random()
+            if roll < 0.08:
+                sig = bytes(rng.getrandbits(8) for _ in range(64))
+            elif roll < 0.16:
+                msg = msg + b"!"
+            elif roll < 0.22:
+                bad = bytearray(sig)
+                bad[rng.randrange(64)] ^= 1 << rng.randrange(8)
+                sig = bytes(bad)
+            items.append((k[32:], msg, sig))
+        return items
+
+    def test_fuzz_parity_with_serial_verify(self):
+        items = self._fuzz_items(160, seed=11)
+        got = ed.verify_batch(items)
+        want = [ed._verify_pure(p, m, s) for p, m, s in items]
+        assert got == want
+        assert not all(want) and any(want)  # the fuzz hit both outcomes
+
+    def test_clean_batch_and_single_fault_localization(self):
+        priv = ed.gen_privkey(b"\x21" * 32)
+        pub = priv[32:]
+        clean = [(pub, b"m%d" % i, ed.sign(priv, b"m%d" % i))
+                 for i in range(72)]
+        assert ed.verify_batch(clean) == [True] * 72
+        # one equation-failing fault (valid sig, wrong message) must be
+        # pinpointed without poisoning its batch-mates
+        dirty = list(clean)
+        dirty[37] = (pub, b"other", dirty[37][2])
+        want = [True] * 72
+        want[37] = False
+        assert ed.verify_batch(dirty) == want
+
+    def test_adversarial_edges_match_serial(self):
+        priv = ed.gen_privkey(b"\x22" * 32)
+        pub = priv[32:]
+        sig = ed.sign(priv, b"m")
+        s = int.from_bytes(sig[32:], "little")
+        cases = [
+            # s+L (Go-accepted malleability zone)
+            (pub, b"m", sig[:32] + (s + ed.L).to_bytes(32, "little")),
+            # top-bit-set s (structural reject)
+            (pub, b"m", sig[:32] + (s | 1 << 255).to_bytes(32, "little")),
+            # R bytes that decompress but re-encode differently (y+p twin of
+            # a small decompressable y) must reject like the serial path
+            ((1 + ed.P).to_bytes(32, "little"), b"m", sig),
+            (pub, b"m", (1 + ed.P).to_bytes(32, "little") + sig[32:]),
+            # R = identity claim with s = 0 against a real pubkey
+            (pub, b"m", (1).to_bytes(32, "little") + b"\x00" * 32),
+            # truncated signature
+            (pub, b"m", sig[:63]),
+        ]
+        assert ed.verify_batch(cases) == \
+            [ed.verify(p, m, sg) for p, m, sg in cases]
+
+    def test_rlc_host_verifier_matches_oracle(self):
+        from tendermint_tpu.crypto.batch import (
+            HostBatchVerifier, RLCHostVerifier, SigItem,
+        )
+
+        items = [SigItem(p, m, s) for p, m, s in self._fuzz_items(48, seed=3)]
+        rlc = RLCHostVerifier().verify_ed25519(items)
+        oracle = HostBatchVerifier().verify_ed25519(items)
+        assert (rlc == oracle).all()
+        pubs = [it.pubkey for it in items]
+        msgs = [it.msg for it in items]
+        sigs = [it.sig for it in items]
+        raw = RLCHostVerifier().verify_ed25519_raw(pubs, msgs, sigs)
+        assert (raw == oracle).all()
 
 
 class TestSecp256k1:

@@ -1,0 +1,372 @@
+"""Streaming vote verification: the VoteSet.prevalidate seam + the
+parallel/planner.py VoteFeed micro-batcher.
+
+The contract under test is BIT-PARITY with the serial path: a storm of
+mixed valid / invalid / duplicate / conflicting / mutated votes pushed
+through prevalidate + VoteFeed + ``add_vote(verified=True)`` must leave
+every vote set in exactly the state the serial ``add_vote`` loop leaves
+it in, raise the same VoteError subclasses in the same places, and mint
+the same conflicting-vote (evidence) pairs.
+"""
+
+import random
+import threading
+import time
+
+import pytest
+
+from tendermint_tpu.crypto.keys import (
+    PrivKeyEd25519,
+    PrivKeySecp256k1,
+)
+from tendermint_tpu.crypto.multisig import Multisignature, PubKeyMultisigThreshold
+from tendermint_tpu.libs import breaker as brk
+from tendermint_tpu.parallel.planner import VoteFeed
+from tendermint_tpu.types import (
+    BlockID,
+    MockPV,
+    PartSetHeader,
+    SignedMsgType,
+    Validator,
+    ValidatorSet,
+    Vote,
+    VoteSet,
+)
+from tendermint_tpu.types.vote import (
+    ErrVoteConflictingVotes,
+    ErrVoteInvalidSignature,
+    VoteError,
+)
+
+CHAIN_ID = "vote-batch-chain"
+TS = 1_700_000_000_000_000_000
+
+
+def block_id(tag: bytes) -> BlockID:
+    return BlockID(hash=tag * 32, parts_header=PartSetHeader(total=1, hash=b"p" * 32))
+
+
+BLOCK_A = block_id(b"a")
+BLOCK_B = block_id(b"b")
+
+
+def make_vals(n, power=10):
+    pvs = [MockPV(PrivKeyEd25519.generate(bytes([i + 1]) * 32)) for i in range(n)]
+    vs = ValidatorSet([Validator(pv.get_pub_key(), power) for pv in pvs])
+    by_addr = {pv.get_pub_key().address(): pv for pv in pvs}
+    return vs, [by_addr[v.address] for v in vs.validators]
+
+
+def make_vote(pv, vs, height, rnd, vtype, bid):
+    addr = pv.get_pub_key().address()
+    idx, _ = vs.get_by_address(addr)
+    vote = Vote(
+        vote_type=vtype,
+        height=height,
+        round=rnd,
+        timestamp_ns=TS,
+        block_id=bid,
+        validator_address=addr,
+        validator_index=idx,
+    )
+    return pv.sign_vote(CHAIN_ID, vote)
+
+
+def build_storm(vs, pvs, seed=7, rounds=(0, 1)):
+    """[(group_key, vote)] mixing honest votes with seeded faults, in a
+    deterministic shuffled arrival order.  group_key = (round, vote_type)."""
+    rng = random.Random(seed)
+    storm = []
+    for rnd in rounds:
+        for vtype in (SignedMsgType.PREVOTE, SignedMsgType.PRECOMMIT):
+            gk = (rnd, vtype)
+            group = []
+            for i, pv in enumerate(pvs):
+                vote = make_vote(pv, vs, 1, rnd, vtype, BLOCK_A)
+                group.append(vote)
+                roll = rng.random()
+                if roll < 0.10:
+                    # garbage signature — fails verification on either path
+                    bad = vote.with_signature(bytes(rng.randrange(256) for _ in range(64)))
+                    group.append(bad)
+                elif roll < 0.20:
+                    # equivocation: properly signed vote for another block
+                    group.append(make_vote(pv, vs, 1, rnd, vtype, BLOCK_B))
+                elif roll < 0.30:
+                    # exact re-gossiped duplicate
+                    group.append(vote)
+                elif roll < 0.38:
+                    # mutated block id carrying the original signature — one
+                    # sig cannot cover both sign bytes, must be rejected
+                    group.append(
+                        make_vote(pv, vs, 1, rnd, vtype, BLOCK_B).with_signature(
+                            vote.signature
+                        )
+                    )
+            rng.shuffle(group)
+            storm.extend((gk, v) for v in group)
+    rng.shuffle(storm)
+    return storm
+
+
+def fresh_sets(vs, rounds=(0, 1)):
+    return {
+        (rnd, vtype): VoteSet(CHAIN_ID, 1, rnd, vtype, vs)
+        for rnd in rounds
+        for vtype in (SignedMsgType.PREVOTE, SignedMsgType.PRECOMMIT)
+    }
+
+
+def run_serial(sets, storm):
+    """The reference path: per-vote add_vote with host verification."""
+    outcomes, evidence = [], []
+    for gk, vote in storm:
+        vset = sets[gk]
+        try:
+            outcomes.append(("added", vset.add_vote(vote)))
+        except ErrVoteConflictingVotes as e:
+            outcomes.append(("conflict", e.added))
+            evidence.append((gk, e.vote_a, e.vote_b))
+        except VoteError as e:
+            outcomes.append((type(e).__name__, None))
+    return outcomes, evidence
+
+
+def run_batched(sets, storm, feed, timeout=180.0):
+    """The streaming path: prevalidate everything, park signatures in the
+    feed, then apply verdict tickets in arrival order."""
+    outcomes, evidence, pending = [], [], []
+    for pos, (gk, vote) in enumerate(storm):
+        vset = sets[gk]
+        try:
+            pv = vset.prevalidate(vote)
+        except VoteError as e:
+            outcomes.append((pos, (type(e).__name__, None)))
+            continue
+        if pv is None:
+            outcomes.append((pos, ("added", False)))
+            continue
+        ticket = feed.submit(
+            gk, pv.pub_key, vote.sign_bytes(vset.chain_id), vote.signature,
+            power=pv.voting_power, total=vset.val_set.total_voting_power(),
+        )
+        pending.append((pos, gk, vote, ticket))
+    for pos, gk, vote, ticket in pending:
+        vset = sets[gk]
+        if not ticket.result(timeout=timeout).ok:
+            # mirror consensus/state.py's verdict handler: re-prevalidate so
+            # structural rejections that materialized in flight surface the
+            # serial path's exact error class
+            try:
+                if vset.prevalidate(vote) is None:
+                    outcomes.append((pos, ("added", False)))
+                else:
+                    outcomes.append((pos, ("ErrVoteInvalidSignature", None)))
+            except VoteError as e:
+                outcomes.append((pos, (type(e).__name__, None)))
+            continue
+        try:
+            outcomes.append((pos, ("added", vset.add_vote(vote, verified=True))))
+        except ErrVoteConflictingVotes as e:
+            outcomes.append((pos, ("conflict", e.added)))
+            evidence.append((gk, e.vote_a, e.vote_b))
+        except VoteError as e:
+            outcomes.append((pos, (type(e).__name__, None)))
+    outcomes.sort()
+    return [o for _, o in outcomes], evidence
+
+
+def assert_same_state(serial_sets, batched_sets):
+    for gk, s in serial_sets.items():
+        b = batched_sets[gk]
+        assert s.bit_array() == b.bit_array(), gk
+        assert s.sum == b.sum, gk
+        assert s.two_thirds_majority() == b.two_thirds_majority(), gk
+        for bid in (BLOCK_A, BLOCK_B):
+            assert s.bit_array_by_block_id(bid) == b.bit_array_by_block_id(bid)
+
+
+class TestStormParity:
+    @pytest.mark.parametrize("n_vals,seed", [(16, 7), (64, 21)])
+    def test_mixed_storm_bit_parity(self, n_vals, seed):
+        vs, pvs = make_vals(n_vals)
+        storm = build_storm(vs, pvs, seed=seed)
+        serial_sets = fresh_sets(vs)
+        want, want_ev = run_serial(serial_sets, storm)
+
+        feed = VoteFeed(use_device=False, window_s=0.01, max_rows=16)
+        try:
+            batched_sets = fresh_sets(vs)
+            got, got_ev = run_batched(batched_sets, storm, feed)
+        finally:
+            feed.close()
+            feed.join(10.0)
+        assert got == want
+        # evidence pairs are minted from identical (vote_a, vote_b) tuples
+        assert sorted(
+            (gk, a.signature, b.signature) for gk, a, b in got_ev
+        ) == sorted((gk, a.signature, b.signature) for gk, a, b in want_ev)
+        assert_same_state(serial_sets, batched_sets)
+        assert feed.votes_in > 0 and feed.dispatches > 0
+
+    def test_secp_and_multisig_ride_host_lanes(self):
+        """Non-ed25519 validators push their whole flush down the host
+        verify_generic path — verdicts still bit-identical to serial."""
+        ed_pvs = [MockPV(PrivKeyEd25519.generate(bytes([i + 1]) * 32))
+                  for i in range(4)]
+        secp_pv = MockPV(PrivKeySecp256k1.generate(b"\x77" * 32))
+        ms_privs = [PrivKeyEd25519.generate(bytes([0x40 + i]) * 32)
+                    for i in range(3)]
+        ms_pub = PubKeyMultisigThreshold(
+            k=2, pubkeys=tuple(p.pub_key() for p in ms_privs)
+        )
+        vals = [Validator(pv.get_pub_key(), 10) for pv in ed_pvs]
+        vals.append(Validator(secp_pv.get_pub_key(), 10))
+        vals.append(Validator(ms_pub, 10))
+        vs = ValidatorSet(vals)
+
+        def ms_sign(vote, good=True):
+            sb = vote.sign_bytes(CHAIN_ID)
+            ms = Multisignature.new(3)
+            pubs = [p.pub_key() for p in ms_privs]
+            ms.add_signature_from_pubkey(ms_privs[0].sign(sb), pubs[0], pubs)
+            second = ms_privs[2].sign(sb if good else b"not the vote")
+            ms.add_signature_from_pubkey(second, pubs[2], pubs)
+            return vote.with_signature(ms.marshal())
+
+        storm = []
+        for pv in ed_pvs + [secp_pv]:
+            addr = pv.get_pub_key().address()
+            idx, _ = vs.get_by_address(addr)
+            vote = Vote(vote_type=SignedMsgType.PREVOTE, height=1, round=0,
+                        timestamp_ns=TS, block_id=BLOCK_A,
+                        validator_address=addr, validator_index=idx)
+            storm.append(((0, SignedMsgType.PREVOTE), pv.sign_vote(CHAIN_ID, vote)))
+        ms_idx, _ = vs.get_by_address(ms_pub.address())
+        ms_vote = Vote(vote_type=SignedMsgType.PREVOTE, height=1, round=0,
+                       timestamp_ns=TS, block_id=BLOCK_A,
+                       validator_address=ms_pub.address(),
+                       validator_index=ms_idx)
+        storm.append(((0, SignedMsgType.PREVOTE), ms_sign(ms_vote, good=True)))
+        # and a bad multisig for the other block — must come back not-ok
+        ms_bad = Vote(vote_type=SignedMsgType.PREVOTE, height=1, round=0,
+                      timestamp_ns=TS, block_id=BLOCK_B,
+                      validator_address=ms_pub.address(),
+                      validator_index=ms_idx)
+        storm.append(((0, SignedMsgType.PREVOTE), ms_sign(ms_bad, good=False)))
+
+        serial_sets = fresh_sets(vs, rounds=(0,))
+        want, _ = run_serial(serial_sets, storm)
+        feed = VoteFeed(use_device=False, window_s=0.01, max_rows=8)
+        try:
+            batched_sets = fresh_sets(vs, rounds=(0,))
+            got, _ = run_batched(batched_sets, storm, feed)
+        finally:
+            feed.close()
+            feed.join(10.0)
+        assert got == want
+        assert_same_state(serial_sets, batched_sets)
+        # 4 ed25519 + secp + 2 multisig all made it to the feed
+        assert feed.votes_in == 7
+
+
+class TestFlushTriggers:
+    def test_quorum_flush_never_waits_out_the_deadline(self):
+        """An urgent (quorum-completing) submit collapses a long window."""
+        vs, pvs = make_vals(4)
+        feed = VoteFeed(use_device=False, window_s=30.0)
+        try:
+            vset = VoteSet(CHAIN_ID, 1, 0, SignedMsgType.PREVOTE, vs)
+            tickets = []
+            t0 = time.monotonic()
+            for i, pv in enumerate(pvs[:3]):
+                vote = make_vote(pv, vs, 1, 0, SignedMsgType.PREVOTE, BLOCK_A)
+                p = vset.prevalidate(vote)
+                tickets.append(feed.submit(
+                    (0, SignedMsgType.PREVOTE), p.pub_key,
+                    vote.sign_bytes(CHAIN_ID), vote.signature,
+                    power=p.voting_power,
+                    total=vs.total_voting_power(),
+                    urgent=(i == 2),  # third vote completes the +2/3
+                ))
+            verdicts = [t.result(timeout=60.0) for t in tickets]
+            elapsed = time.monotonic() - t0
+        finally:
+            feed.close()
+            feed.join(10.0)
+        assert all(v.ok for v in verdicts)
+        assert verdicts[0].flush_reason == "quorum"
+        assert elapsed < 25.0  # nowhere near the 30s window
+        assert feed.flushes["quorum"] == 1
+
+    def test_deadline_flush_fires_without_urgency(self):
+        vs, pvs = make_vals(4)
+        feed = VoteFeed(use_device=False, window_s=0.02)
+        try:
+            vote = make_vote(pvs[0], vs, 1, 0, SignedMsgType.PREVOTE, BLOCK_A)
+            vset = VoteSet(CHAIN_ID, 1, 0, SignedMsgType.PREVOTE, vs)
+            p = vset.prevalidate(vote)
+            t = feed.submit((0, SignedMsgType.PREVOTE), p.pub_key,
+                            vote.sign_bytes(CHAIN_ID), vote.signature,
+                            power=p.voting_power, total=vs.total_voting_power())
+            v = t.result(timeout=60.0)
+        finally:
+            feed.close()
+            feed.join(10.0)
+        assert v.ok and v.flush_reason == "deadline"
+        assert feed.flushes["deadline"] == 1
+
+
+class TestGuardFallback:
+    def test_breaker_open_feed_still_resolves(self):
+        """A quarantined device breaker must not take the vote path down:
+        the planner's guard diverts the flush to the host backend and every
+        ticket still resolves with the correct verdict."""
+        brk.get_device_breaker().quarantine("vote_batch_test")
+        try:
+            vs, pvs = make_vals(4)
+            feed = VoteFeed(window_s=0.01)  # use_device unset: guard decides
+            try:
+                vset = VoteSet(CHAIN_ID, 1, 0, SignedMsgType.PREVOTE, vs)
+                good = make_vote(pvs[0], vs, 1, 0, SignedMsgType.PREVOTE, BLOCK_A)
+                bad = make_vote(pvs[1], vs, 1, 0, SignedMsgType.PREVOTE,
+                                BLOCK_A).with_signature(b"\x01" * 64)
+                pg = vset.prevalidate(good)
+                pb = vset.prevalidate(bad)
+                tg = feed.submit((0, 1), pg.pub_key,
+                                 good.sign_bytes(CHAIN_ID), good.signature)
+                tb = feed.submit((0, 1), pb.pub_key,
+                                 bad.sign_bytes(CHAIN_ID), bad.signature)
+                assert tg.result(timeout=120.0).ok is True
+                assert tb.result(timeout=120.0).ok is False
+            finally:
+                feed.close()
+                feed.join(10.0)
+        finally:
+            brk.get_device_breaker().reset()
+
+
+class TestLifecycle:
+    def test_close_drains_pending_and_exits_worker(self):
+        vs, pvs = make_vals(4)
+        feed = VoteFeed(use_device=False, window_s=60.0)
+        vset = VoteSet(CHAIN_ID, 1, 0, SignedMsgType.PREVOTE, vs)
+        vote = make_vote(pvs[0], vs, 1, 0, SignedMsgType.PREVOTE, BLOCK_A)
+        p = vset.prevalidate(vote)
+        t = feed.submit((0, 1), p.pub_key, vote.sign_bytes(CHAIN_ID),
+                        vote.signature)
+        feed.close()
+        v = t.result(timeout=60.0)  # pending vote still flushed, not dropped
+        assert v.ok and v.flush_reason == "close"
+        feed.join(10.0)
+        assert feed._thread is not None and not feed._thread.is_alive()
+        with pytest.raises(RuntimeError):
+            feed.submit((0, 1), p.pub_key, b"m", b"s" * 64)
+
+    def test_close_without_submissions_leaks_nothing(self):
+        before = {th.name for th in threading.enumerate()}
+        feed = VoteFeed(use_device=False)
+        feed.close()
+        feed.join(5.0)
+        after = {th.name for th in threading.enumerate()} - before
+        assert not {n for n in after if n.startswith("planner-vote-feed")}
