@@ -6,7 +6,7 @@ PYTHON ?= python
         planner-bench pallas-bench bench_secp bench_multisig mempool-bench \
         lite-bench multichip-bench vote-bench metrics-lint bench-check \
         statesync-smoke \
-        flight-smoke chaos-smoke \
+        flight-smoke chaos-smoke critpath-smoke critpath-bench \
         localnet-start localnet-stop build-docker-localnode
 
 test:
@@ -101,6 +101,22 @@ statesync-smoke:
 # Chrome trace-event JSON with agreeing commit anchors
 flight-smoke:
 	JAX_PLATFORMS=cpu $(PYTHON) scripts/flight_smoke.py
+
+# commit-latency waterfall end to end on the flight smoke's 4-node net:
+# per-height phase sums must reconcile with wall height time, the
+# height_phase_seconds exposition must lint with every phase label, and
+# the merged trace must carry strictly nested waterfall slices
+critpath-smoke:
+	JAX_PLATFORMS=cpu $(PYTHON) scripts/critpath_smoke.py
+
+# signing-to-commit p99 under vote_storm + mempool_flood on the sim
+# fabric, pooled from every node's critical-path waterfalls; appends a
+# CRITPATH_rNN.json round then gates commit_p99_seconds (latency: lower
+# is better) against the previous round
+critpath-bench:
+	JAX_PLATFORMS=cpu $(PYTHON) scripts/bench_commit_path.py $(ARGS)
+	$(PYTHON) scripts/bench_check.py --prefix CRITPATH \
+	  --metric commit_p99_seconds:0.25:lower
 
 # deterministic chaos/Byzantine scenario matrix over the in-proc sim fabric:
 # safety + liveness + seeded-fault replayability per scenario, run-to-run

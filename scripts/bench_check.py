@@ -54,6 +54,10 @@ DEFAULT_METRICS = [
     # live-vote micro-batcher headline (scripts/bench_votes.py /
     # make vote-bench — VOTES_r*.json rounds via --prefix)
     "vote_verify_per_s:0.25:higher",
+    # signing-to-commit p99 under vote_storm + mempool_flood
+    # (scripts/bench_commit_path.py / make critpath-bench —
+    # CRITPATH_r*.json rounds via --prefix); latency: lower is better
+    "commit_p99_seconds:0.25:lower",
 ]
 DEFAULT_THRESHOLD = 0.20
 

@@ -1,0 +1,178 @@
+"""Commit-path latency bench (`make critpath-bench`): signing-to-commit
+p99 under adversarial load, with the per-phase breakdown.
+
+Drives the deterministic sim fabric through the two storm scenarios the
+ROADMAP names as the write-path stressors — `vote_storm` (duplicate/
+equivocation gossip squalls through the vote micro-batcher) and
+`mempool_flood` (spam flood against per-peer QoS) — with every node's
+flight recorder on, then pools the per-height commit-latency waterfalls
+that the critical-path analyzer (libs/critpath.py) built during the run.
+
+Headline: `commit_p99_seconds`, the p99 of per-height signing-to-commit
+wall time (new-round entry -> +2/3 precommits) across every node and both
+scenarios.  This is the baseline number the group-commit WAL work will be
+judged against.  The per-phase p50/p99 table shows WHERE the p99 lives —
+the waterfall's answer to "which phase do we optimize next".
+
+Writes the next ``CRITPATH_rNN.json`` round with a ``parsed`` dict;
+``make critpath-bench`` runs this then gates ``commit_p99_seconds``
+(lower is better) via ``bench_check.py --prefix CRITPATH``.
+
+Usage: python scripts/bench_commit_path.py [--scenarios vote_storm,mempool_flood]
+                                           [--min-heights 6] [--round-dir REPO_ROOT]
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tendermint_tpu.libs.critpath import PHASES, percentile  # noqa: E402
+
+
+def _run_scenarios(names):
+    from tendermint_tpu.sim.scenario import run_scenario
+    from tendermint_tpu.sim.scenarios import SCENARIOS
+
+    results = []
+    for name in names:
+        if name not in SCENARIOS:
+            raise SystemExit(f"unknown scenario {name!r} "
+                             f"(have: {', '.join(sorted(SCENARIOS))})")
+        result = run_scenario(SCENARIOS[name]())
+        results.append(result)
+        print(json.dumps({
+            "stage": name,
+            "ok": result.ok,
+            "failures": result.failures,
+            "elapsed_s": result.elapsed_s,
+            "heights": result.heights,
+            "waterfalls": sum(
+                d.get("total_records", 0) for d in result.critpath_dumps
+            ),
+        }), flush=True)
+    return results
+
+
+def _pool(results):
+    """Pool per-height samples across nodes and scenarios: commit
+    latencies plus per-phase seconds, straight from the waterfalls."""
+    commits = []
+    phases = {p: [] for p in PHASES}
+    criticals = {}
+    for result in results:
+        for dump in result.critpath_dumps:
+            for wf in dump.get("records", []):
+                commits.append(wf["commit_seconds"])
+                for p in PHASES:
+                    phases[p].append(wf["phases"][p])
+                cp = wf["critical_path"]
+                criticals[cp] = criticals.get(cp, 0) + 1
+    return commits, phases, criticals
+
+
+def _phase_table(phases, commits) -> str:
+    """Markdown per-phase breakdown (PERF.md's waterfall table)."""
+    lines = [
+        "| phase | p50 (ms) | p99 (ms) | share of p50 commit |",
+        "|---|---|---|---|",
+    ]
+    c50 = percentile(commits, 50) or 1.0
+    for p in PHASES:
+        xs = phases[p]
+        p50, p99 = percentile(xs, 50), percentile(xs, 99)
+        lines.append(
+            f"| {p} | {1e3 * p50:.2f} | {1e3 * p99:.2f} "
+            f"| {100.0 * p50 / c50:.0f}% |"
+        )
+    lines.append(
+        f"| **commit (signing-to-commit)** "
+        f"| **{1e3 * percentile(commits, 50):.2f}** "
+        f"| **{1e3 * percentile(commits, 99):.2f}** | 100% |"
+    )
+    return "\n".join(lines)
+
+
+def _write_round(round_dir: str, parsed: dict, tail: str) -> str:
+    ns = [
+        int(m.group(1))
+        for p in glob.glob(os.path.join(round_dir, "CRITPATH_r*.json"))
+        if (m := re.search(r"CRITPATH_r(\d+)\.json$", os.path.basename(p)))
+    ]
+    path = os.path.join(
+        round_dir, f"CRITPATH_r{max(ns, default=0) + 1:02d}.json"
+    )
+    with open(path, "w") as f:
+        json.dump({"rc": 0, "tail": tail, "parsed": parsed}, f, indent=2)
+        f.write("\n")
+    print(f"# bench round -> {path}", file=sys.stderr)
+    return path
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--scenarios", default="vote_storm,mempool_flood",
+                   help="comma-separated sim scenario names to drive")
+    p.add_argument("--min-heights", type=int, default=6,
+                   help="pooled waterfall floor: fewer committed heights "
+                        "than this across the whole run is a failed bench")
+    p.add_argument("--round-dir", default=os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))),
+        help="where CRITPATH_rNN.json rounds land ('' skips the round)")
+    args = p.parse_args()
+
+    names = [s.strip() for s in args.scenarios.split(",") if s.strip()]
+    results = _run_scenarios(names)
+    commits, phases, criticals = _pool(results)
+
+    # scenario check failures mean the storm itself misbehaved — say so
+    # loudly, but only an empty waterfall pool fails the bench (the gate
+    # compares latency, and latency came from the heights that DID commit)
+    for result in results:
+        for failure in result.failures:
+            print(f"WARNING: {result.name}: {failure}", file=sys.stderr)
+    if len(commits) < args.min_heights:
+        print(f"FAILED: only {len(commits)} committed-height waterfalls "
+              f"pooled (need >= {args.min_heights})", file=sys.stderr)
+        return 1
+
+    parsed = {
+        "commit_p99_seconds": round(percentile(commits, 99), 6),
+        "commit_p50_seconds": round(percentile(commits, 50), 6),
+        "commit_heights": len(commits),
+        "scenarios": {r.name: {"ok": r.ok, "heights": r.heights}
+                      for r in results},
+        "critical_path_counts": criticals,
+        "phases": {
+            p_: {
+                "p50_seconds": round(percentile(phases[p_], 50), 6),
+                "p99_seconds": round(percentile(phases[p_], 99), 6),
+            }
+            for p_ in PHASES
+        },
+    }
+    tail = json.dumps({
+        "metric": "commit_p99_seconds",
+        "value": parsed["commit_p99_seconds"],
+        "unit": "s",
+        "commit_p50_seconds": parsed["commit_p50_seconds"],
+        "commit_heights": parsed["commit_heights"],
+        "critical_path_counts": criticals,
+    })
+    print(tail, flush=True)
+    print("\n" + _phase_table(phases, commits) + "\n", flush=True)
+    if args.round_dir:
+        _write_round(args.round_dir, parsed, tail)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,221 @@
+"""Critical-path analyzer smoke test (`make critpath-smoke`).
+
+Drives the commit-latency waterfall end to end, in one process, on CPU,
+reusing the flight smoke's 4-validator in-proc net (real ConsensusStates
+over a crypto-free event-bus gossip pump):
+
+  1. run consensus past a target height with every node's flight recorder
+     on — the critical-path analyzer (libs/critpath.py) piggybacks on the
+     finalize path and builds one waterfall per committed height; node0
+     additionally runs a REAL file WAL so the height-tagged append/fsync
+     join is exercised, not just the NilWAL zero path;
+  2. assert the dump_critpath contract on every node: records present,
+     limit/truncated consistent, and each waterfall's timeline phase sum
+     plus its explicit residual reconciling with the wall height time;
+  3. lint the `tendermint_consensus_height_phase_seconds` exposition with
+     the strict metrics_lint parser and require every phase label;
+  4. merge the flight dumps with scripts/trace_merge.py and strict-validate
+     the result as Chrome trace — including the nested waterfall slices
+     (every phase slice contained in its parent `waterfall h` slice).
+
+Exit code 0 means stamping, fusing, reconciliation, exposition, and the
+merged waterfall view all work end to end on this machine.
+"""
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _ROOT)
+sys.path.insert(0, os.path.join(_ROOT, "tests"))
+
+import flight_smoke  # noqa: E402  (sibling script: _Net + validator)
+import trace_merge  # noqa: E402  (sibling script)
+from metrics_lint import lint_text  # noqa: E402  (sibling script)
+
+from consensus_harness import wait_for  # noqa: E402  (tests/ dir on path)
+
+from tendermint_tpu.consensus.wal import WAL  # noqa: E402
+from tendermint_tpu.libs.critpath import (  # noqa: E402
+    PHASES,
+    TIMELINE_PHASES,
+)
+from tendermint_tpu.libs.metrics import NodeMetrics  # noqa: E402
+
+N_VALS = flight_smoke.N_VALS
+TARGET_HEIGHT = 4
+# wall-vs-phase reconciliation tolerance: the identity is exact in ns
+# arithmetic, float64 seconds round-trips leave sub-microsecond dust
+RECONCILE_TOL_S = 1e-6
+
+
+def _check_snapshot(snap: dict, node: str, failures: list) -> None:
+    """The dump_critpath contract + the reconciliation identity."""
+    recs = snap["records"]
+    if snap["total_records"] < TARGET_HEIGHT - 1:
+        failures.append(
+            f"{node}: only {snap['total_records']} waterfalls "
+            f"(need >= {TARGET_HEIGHT - 1})"
+        )
+    if snap["truncated"]:
+        failures.append(f"{node}: unlimited snapshot claims truncated")
+    if len(recs) != snap["total_records"]:
+        failures.append(
+            f"{node}: {len(recs)} records shipped vs "
+            f"total_records={snap['total_records']}"
+        )
+    if snap["analysis_errors"]:
+        failures.append(
+            f"{node}: {snap['analysis_errors']} analyzer errors"
+        )
+    for wf in recs:
+        h = wf["height"]
+        for phase in PHASES:
+            if wf["phases"][phase] < 0:
+                failures.append(
+                    f"{node} h={h}: negative phase {phase} "
+                    f"{wf['phases'][phase]}"
+                )
+        timeline = sum(wf["phases"][p] for p in TIMELINE_PHASES)
+        resid = wf["wall_seconds"] - (timeline + wf["other_seconds"])
+        if abs(resid) > RECONCILE_TOL_S:
+            failures.append(
+                f"{node} h={h}: phase sum {timeline + wf['other_seconds']:.9f}"
+                f" != wall {wf['wall_seconds']:.9f} (resid {resid:.3e})"
+            )
+        if wf["other_seconds"] < -RECONCILE_TOL_S:
+            failures.append(
+                f"{node} h={h}: negative residual "
+                f"{wf['other_seconds']:.3e} — overlapping timeline phases"
+            )
+        if not (0.0 <= wf["commit_seconds"] <= wf["wall_seconds"] + 1e-9):
+            failures.append(
+                f"{node} h={h}: commit_seconds {wf['commit_seconds']} "
+                f"outside [0, wall={wf['wall_seconds']}]"
+            )
+        if wf["critical_path"] not in PHASES:
+            failures.append(
+                f"{node} h={h}: bogus critical_path {wf['critical_path']!r}"
+            )
+
+
+def _check_waterfall_slices(merged: dict, failures: list) -> None:
+    """Nested-slice check: every critpath phase slice sits inside its
+    node's parent `waterfall h` slice (Chrome nests by ts/dur containment
+    on one pid/tid)."""
+    parents = {}  # (pid, height) -> (ts, ts+dur)
+    children = []
+    for ev in merged["traceEvents"]:
+        if ev.get("cat") != "critpath":
+            continue
+        if ev["name"].startswith("waterfall "):
+            key = (ev["pid"], ev["args"]["height"])
+            parents[key] = (ev["ts"], ev["ts"] + ev["dur"])
+        else:
+            children.append(ev)
+    if not parents:
+        failures.append("merged trace has no waterfall parent slices")
+    for ev in children:
+        key = (ev["pid"], ev["args"]["height"])
+        span = parents.get(key)
+        if span is None:
+            failures.append(
+                f"phase slice {ev['name']} (pid {ev['pid']} "
+                f"h={ev['args']['height']}) has no parent waterfall"
+            )
+            continue
+        t0, t1 = ev["ts"], ev["ts"] + ev["dur"]
+        if t0 < span[0] - 1e-6 or t1 > span[1] + 1e-6:
+            failures.append(
+                f"phase slice {ev['name']} (pid {ev['pid']} "
+                f"h={ev['args']['height']}) [{t0}, {t1}] escapes parent "
+                f"[{span[0]}, {span[1]}]"
+            )
+
+
+def main() -> int:
+    failures = []
+    metrics = NodeMetrics()
+    net = flight_smoke._Net()
+    wal_dir = tempfile.mkdtemp(prefix="critpath_smoke_wal_")
+    # node0 gets a real file WAL (assigned before start: cs.on_start owns
+    # wal.start + the empty-file catchup replay) so its waterfalls carry
+    # height-tagged append/fsync costs
+    cs0 = net.nodes[0][0]
+    cs0.wal = WAL(os.path.join(wal_dir, "wal"))
+    for cs, _, _ in net.nodes:
+        cs.critpath.metrics = metrics  # shared registry: exposition check
+    try:
+        net.start()
+        print(f"[critpath-smoke] running {N_VALS}-node net to height "
+              f"{TARGET_HEIGHT}...")
+        ok = wait_for(
+            lambda: all(cs.rs.height > TARGET_HEIGHT
+                        for cs, _, _ in net.nodes),
+            timeout=60.0,
+        )
+        if not ok:
+            heights = [cs.rs.height for cs, _, _ in net.nodes]
+            return _fail([f"net never reached height {TARGET_HEIGHT + 1}: "
+                          f"heights={heights}"])
+
+        snaps = [cs.critpath.snapshot() for cs, _, _ in net.nodes]
+        for snap, (cs, _, _) in zip(snaps, net.nodes):
+            _check_snapshot(snap, snap["node_id"] or "?", failures)
+        print(f"[critpath-smoke] {sum(s['total_records'] for s in snaps)} "
+              f"waterfalls across {N_VALS} nodes reconcile")
+
+        # limit/truncated contract, same rules as dump_flight
+        limited = net.nodes[0][0].critpath.snapshot(limit=2)
+        if len(limited["records"]) != 2 or not limited["truncated"]:
+            failures.append(
+                f"limit=2 snapshot broke the truncation contract: "
+                f"{len(limited['records'])} records, "
+                f"truncated={limited['truncated']}"
+            )
+
+        # node0's real WAL must have produced height-tagged costs
+        node0 = snaps[0]["records"]
+        if not any(wf["phases"]["wal_fsync"] > 0 or wf["wal_fsyncs"] > 0
+                   for wf in node0):
+            failures.append(
+                "node0 runs a real WAL but no waterfall carries fsync cost"
+            )
+
+        text = metrics.registry.expose_text()
+        for phase in PHASES:
+            needle = f'phase="{phase}"'
+            if needle not in text:
+                failures.append(f"exposition missing series {needle}")
+        failures.extend(f"metrics_lint: {e}" for e in lint_text(text))
+
+        print("[critpath-smoke] merging flight dumps with waterfalls...")
+        dumps = [cs.flight.snapshot() for cs, _, _ in net.nodes]
+        skews = trace_merge.compute_skews(dumps)
+        merged = trace_merge.merge(dumps, skews=skews)
+        failures.extend(flight_smoke.validate_chrome_trace(
+            merged, N_VALS, min_commits_per_node=TARGET_HEIGHT - 1
+        ))
+        _check_waterfall_slices(merged, failures)
+    finally:
+        net.stop()
+        shutil.rmtree(wal_dir, ignore_errors=True)
+
+    if failures:
+        return _fail(failures)
+    print("[critpath-smoke] OK")
+    return 0
+
+
+def _fail(failures) -> int:
+    for f in failures:
+        print(f"[critpath-smoke] FAIL: {f}", file=sys.stderr)
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

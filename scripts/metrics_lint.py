@@ -346,6 +346,10 @@ def _self_check():
     nm.vote_arrival_latency.observe(0.03, ("prevote",))
     nm.wal_append_seconds.observe(0.0004)
     nm.wal_fsync_seconds.observe(0.002)
+    from tendermint_tpu.libs.critpath import PHASES as _CRIT_PHASES
+
+    for i, _phase in enumerate(_CRIT_PHASES):
+        nm.height_phase_seconds.observe(0.001 * (i + 1), (_phase,))
     nm.mempool_tx_size_bytes.observe(512.0)
     nm.mempool_failed_txs.add(1.0)
     nm.mempool_recheck_times.add(2.0)
@@ -378,6 +382,22 @@ def _self_check():
     if missing:
         failures.append(
             ("reference-name parity", [f"missing family {n}" for n in missing])
+        )
+    # critpath family parity: the commit-latency waterfall histogram
+    # (libs/critpath.py) feeds tm_monitor's CRIT column and the waterfall
+    # runbook under this exact name, with one series per PHASES entry
+    critpath_names = ("tendermint_consensus_height_phase_seconds",)
+    missing_cp = [
+        n for n in critpath_names if f"# TYPE {n} " not in node_text
+    ]
+    missing_cp.extend(
+        f'phase label "{p}"' for p in _CRIT_PHASES
+        if f'phase="{p}"' not in node_text
+    )
+    if missing_cp:
+        failures.append(
+            ("critpath family parity",
+             [f"missing {n}" for n in missing_cp])
         )
     # device-guard family parity: the breaker gauge + fallback/retry/audit
     # counters tm_monitor's DEVICE column and the runbooks scrape must keep

@@ -3,7 +3,10 @@
 Fetches `dump_flight` (per-height consensus lifecycle records) and optionally
 `dump_trace` (span-tracer rings) from a comma-separated endpoint list and
 fuses them into ONE Chrome trace-event JSON — one track (pid) per node — for
-chrome://tracing or ui.perfetto.dev.
+chrome://tracing or ui.perfetto.dev.  Each node's track carries two threads:
+tid 0 "consensus" (lifecycle instants + height spans) and tid 1 "waterfall"
+(per-committed-height commit-latency waterfalls as nested phase slices,
+built by libs/critpath.py from the same records).
 
 Clock alignment: every flight record carries wall-clock timestamps, but node
 wall clocks disagree (NTP skew).  A commit of height H with hash X is the
@@ -32,6 +35,24 @@ import sys
 from typing import Dict, List, Optional, Tuple
 
 _FLIGHT_TID = 0  # every flight-recorder track uses tid 0 ("consensus")
+_WATERFALL_TID = 1  # commit-latency waterfall slices (libs/critpath.py)
+
+
+def _critpath():
+    """Lazy import of the waterfall builder: as a module import the repo
+    root is already on sys.path (smokes/tests); as a standalone CLI the
+    __main__ block inserts it, but only after this module loaded — so the
+    fallback insert here keeps the operator path working too."""
+    try:
+        from tendermint_tpu.libs import critpath
+    except ImportError:
+        import os
+
+        sys.path.insert(
+            0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        )
+        from tendermint_tpu.libs import critpath
+    return critpath
 
 
 def _commit_anchors(dump: dict) -> Dict[Tuple[int, str], int]:
@@ -119,6 +140,53 @@ def _us(t_ns: int, skew_ns: int) -> float:
     return (t_ns + skew_ns) / 1000.0
 
 
+def _waterfall_events(rec: dict, pid: int, skew_ns: int) -> List[dict]:
+    """Commit-latency waterfall for one committed height as NESTED Chrome
+    slices: a parent `waterfall h` X slice spanning the height's wall time
+    on the waterfall track, with one child X slice per timeline phase
+    (children nest by ts/dur containment on the same pid/tid — the Chrome
+    trace nesting rule).  Uncommitted heights emit nothing."""
+    cp = _critpath()
+    wf = cp.build_waterfall(rec)
+    if wf is None:
+        return []
+    # all endpoints converted to µs FIRST, durations taken as float
+    # differences of those endpoints: at wall-clock magnitude (~1e15 µs)
+    # float64 resolves ~0.25µs, so mixing ns-difference durations with
+    # µs-converted starts would let children escape their parent by a
+    # rounding ulp and break strict nesting validation
+    p0 = _us(wf["t_start_ns"], skew_ns)
+    p1 = max(_us(wf["t_end_ns"], skew_ns), p0)
+    events = [{
+        "name": f"waterfall {wf['height']}", "cat": "critpath", "ph": "X",
+        "pid": pid, "tid": _WATERFALL_TID,
+        "ts": p0, "dur": p1 - p0,
+        "args": {
+            "height": wf["height"],
+            "critical_path": wf["critical_path"],
+            "commit_seconds": wf["commit_seconds"],
+            "other_seconds": wf["other_seconds"],
+            "wal_append_seconds": wf["phases"]["wal_append"],
+            "wal_fsync_seconds": wf["phases"]["wal_fsync"],
+            "verify_dispatch_seconds": wf["verify_dispatch_seconds"],
+        },
+    }]
+    for seg in wf["segments"]:
+        s0 = min(max(_us(seg["t0_ns"], skew_ns), p0), p1)
+        s1 = min(max(_us(seg["t1_ns"], skew_ns), s0), p1)
+        events.append({
+            "name": seg["phase"], "cat": "critpath", "ph": "X",
+            "pid": pid, "tid": _WATERFALL_TID,
+            "ts": s0, "dur": s1 - s0,
+            "args": {
+                "height": wf["height"],
+                "seconds": wf["phases"][seg["phase"]],
+                "critical": seg["phase"] == wf["critical_path"],
+            },
+        })
+    return events
+
+
 def _flight_events(dump: dict, pid: int, skew_ns: int) -> List[dict]:
     node = dump.get("node_id") or f"node{pid}"
     events: List[dict] = [
@@ -126,6 +194,8 @@ def _flight_events(dump: dict, pid: int, skew_ns: int) -> List[dict]:
          "args": {"name": node}},
         {"name": "thread_name", "ph": "M", "pid": pid, "tid": _FLIGHT_TID,
          "args": {"name": "consensus"}},
+        {"name": "thread_name", "ph": "M", "pid": pid, "tid": _WATERFALL_TID,
+         "args": {"name": "waterfall"}},
     ]
 
     def instant(name: str, t_ns: int, **args) -> None:
@@ -191,6 +261,7 @@ def _flight_events(dump: dict, pid: int, skew_ns: int) -> List[dict]:
                     "precommits": (rec.get("precommit") or {}).get("count", 0),
                 },
             })
+        events.extend(_waterfall_events(rec, pid, skew_ns))
     return events
 
 

@@ -115,6 +115,7 @@ class FlightRecorder:
             "precommit": _vote_slot(),
             "polka": None,      # {t, round}
             "commit": None,     # {t, round, hash}
+            "persist": None,    # {t, dur_ns} — block-store save_block span
             "exec": None,       # {t, dur_ns}
         }
         self._buf[slot] = rec
@@ -196,6 +197,13 @@ class FlightRecorder:
                     "hash": (block_hash or b"").hex().upper(),
                 }
 
+    def on_persist(self, height: int, t0_ns: int, t1_ns: int) -> None:
+        """The block-store save_block span for the committed height."""
+        if not self.enabled:
+            return
+        with self._mtx:
+            self._rec(height)["persist"] = {"t": t0_ns, "dur_ns": t1_ns - t0_ns}
+
     def on_execute(self, height: int, t0_ns: int, t1_ns: int) -> None:
         """The ABCI apply_block span for the committed height."""
         if not self.enabled:
@@ -204,29 +212,46 @@ class FlightRecorder:
             self._rec(height)["exec"] = {"t": t0_ns, "dur_ns": t1_ns - t0_ns}
 
     # export ----------------------------------------------------------------
+    def peek(self, height: int) -> Optional[dict]:
+        """Deep copy of one height's record, or None (critpath analyzer)."""
+        with self._mtx:
+            slot = self._by_height.get(height)
+            return None if slot is None else _copy.deepcopy(self._buf[slot])
+
+    def _records_locked(self, limit: Optional[int]) -> List[dict]:
+        heights = sorted(self._by_height)
+        if limit is not None and limit >= 0:
+            heights = heights[-limit:] if limit else []
+        return [_copy.deepcopy(self._buf[self._by_height[h]]) for h in heights]
+
     def records(self, limit: Optional[int] = None) -> List[dict]:
         """Deep-copied records, oldest first (newest N when limit is set)."""
         with self._mtx:
-            heights = sorted(self._by_height)
-            if limit is not None and limit >= 0:
-                heights = heights[-limit:] if limit else []
-            out = [
-                _copy.deepcopy(self._buf[self._by_height[h]]) for h in heights
-            ]
-        return out
+            return self._records_locked(limit)
 
     def snapshot(self, limit: Optional[int] = None) -> dict:
         """The dump_flight RPC payload: records plus the metadata the
-        cross-node merger needs."""
+        cross-node merger needs.
+
+        Everything derived — total, the record list, the evicted counter,
+        and the truncated flag — is computed under ONE lock acquisition.
+        The old shape (len under the lock, then records()/evicted() each
+        re-locking) let a hook fire between acquisitions when the ring
+        wraps mid-height, shipping a truncated flag that contradicted the
+        record list next to it."""
         with self._mtx:
             total = len(self._by_height)
-        recs = self.records(limit)
-        return {
-            "node_id": self.node_id,
-            "enabled": self.enabled,
-            "capacity": self.capacity,
-            "evicted": self.evicted(),
-            "total_records": total,
-            "truncated": len(recs) < total,
-            "records": recs,
-        }
+            live = min(self._next, self.capacity)
+            assert total == live, (
+                f"flight ring accounting drift: {total} indexed, {live} live"
+            )
+            recs = self._records_locked(limit)
+            return {
+                "node_id": self.node_id,
+                "enabled": self.enabled,
+                "capacity": self.capacity,
+                "evicted": self._evicted,
+                "total_records": total,
+                "truncated": len(recs) < total,
+                "records": recs,
+            }

@@ -37,6 +37,7 @@ from tendermint_tpu.consensus.flight import FlightRecorder
 from tendermint_tpu.consensus.ticker import TimeoutTicker
 from tendermint_tpu.consensus.wal import NilWAL, WAL
 from tendermint_tpu.libs import trace
+from tendermint_tpu.libs.critpath import CritPath
 from tendermint_tpu.libs.events import EventSwitch
 from tendermint_tpu.libs.service import BaseService
 from tendermint_tpu.types import (
@@ -110,6 +111,9 @@ class ConsensusState(BaseService):
         # per-height lifecycle ledger; disabled unless TM_FLIGHT /
         # [instrumentation] flight_recorder / flight_reset turns it on
         self.flight = FlightRecorder.from_env()
+        # commit-latency waterfall analyzer; piggybacks on the flight
+        # recorder's enable gate (no stamps -> nothing to analyze)
+        self.critpath = CritPath(metrics=metrics)
         # wall-clock source for proposal/vote timestamps and latency
         # accounting; the sim harness swaps in a skewed/frozen clock
         self.now_ns: Callable[[], int] = time.time_ns
@@ -285,6 +289,11 @@ class ConsensusState(BaseService):
 
     def _update_height(self, height: int) -> None:
         self.rs.height = height
+        # tag subsequent WAL appends/fsyncs with the height they belong to
+        # (custom WALs in tests may not implement the height-join surface)
+        set_h = getattr(self.wal, "set_height", None)
+        if set_h is not None:
+            set_h(height)
 
     def _update_round_step(self, round: int, step: RoundStepType) -> None:
         self.rs.round = round
@@ -848,7 +857,9 @@ class ConsensusState(BaseService):
         if self.block_store.height() < block.height:
             precommits = rs.votes.precommits(rs.commit_round)
             seen_commit = precommits.make_commit()
+            persist_t0 = self.now_ns()
             self.block_store.save_block(block, block_parts, seen_commit)
+            self.flight.on_persist(height, persist_t0, self.now_ns())
 
         fail.fail_point()
 
@@ -869,6 +880,9 @@ class ConsensusState(BaseService):
             self.logger.error("error on ApplyBlock: %s — halting", e)
             raise
         self.flight.on_execute(height, exec_t0, self.now_ns())
+        # the height's lifecycle is complete — fuse its flight stamps, WAL
+        # costs, and verify-dispatch ledger into one waterfall record
+        self.critpath.on_height_complete(height, self.flight, wal=self.wal)
 
         fail.fail_point()
 

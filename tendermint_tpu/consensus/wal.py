@@ -11,10 +11,11 @@ from __future__ import annotations
 
 import io
 import struct
+import threading
 import time
 import zlib
 from dataclasses import dataclass
-from typing import Iterator, Optional, Tuple
+from typing import Dict, Iterator, Optional, Tuple
 
 from tendermint_tpu.consensus.messages import (
     EndHeightMessage,
@@ -74,10 +75,47 @@ class TimedWALMessage:
 
 
 class WAL(BaseService):
+    # per-height cost accumulators kept (oldest dropped past this); 64
+    # heights comfortably covers any consumer lag on the finalize path
+    HEIGHT_COST_KEEP = 64
+
     def __init__(self, wal_file: str, metrics=None):
         super().__init__("consensus.WAL")
         self.group = Group(wal_file)
         self.metrics = metrics  # NodeMetrics or None
+        # height tag for spans + per-height cost join (critpath analyzer);
+        # ConsensusState advances it via set_height on height transitions
+        self._height = 0
+        self._height_costs: Dict[int, dict] = {}
+        self._cost_mtx = threading.Lock()
+
+    # height attribution ---------------------------------------------------
+    def set_height(self, height: int) -> None:
+        self._height = int(height)
+
+    def _account(self, kind: str, seconds: float) -> None:
+        with self._cost_mtx:
+            c = self._height_costs.get(self._height)
+            if c is None:
+                c = {"append_seconds": 0.0, "fsync_seconds": 0.0,
+                     "appends": 0, "fsyncs": 0}
+                self._height_costs[self._height] = c
+                while len(self._height_costs) > self.HEIGHT_COST_KEEP:
+                    self._height_costs.pop(min(self._height_costs))
+            c[f"{kind}_seconds"] += seconds
+            c[f"{kind}s"] += 1
+
+    def height_costs(self, height: int) -> Optional[dict]:
+        """Accumulated WAL costs for one height, or None."""
+        with self._cost_mtx:
+            c = self._height_costs.get(int(height))
+            return dict(c) if c is not None else None
+
+    def pop_height_costs(self, height: int) -> Optional[dict]:
+        """Like height_costs but removes the accumulator — the critpath
+        analyzer consumes each height exactly once at finalize."""
+        with self._cost_mtx:
+            return self._height_costs.pop(int(height), None)
 
     # writes ---------------------------------------------------------------
     def write(self, msg: object) -> None:
@@ -89,21 +127,25 @@ class WAL(BaseService):
             raise ValueError(f"WAL msg too big: {len(payload)}")
         rec = struct.pack("<I", zlib.crc32(payload)) + encode_uvarint(len(payload)) + payload
         t0 = time.monotonic()
-        with trace.span("wal.append", bytes=len(rec)):
+        with trace.span("wal.append", bytes=len(rec), height=self._height):
             self.group.write(rec)
             self.group.flush()
+        dt = time.monotonic() - t0
+        self._account("append", dt)
         if self.metrics is not None:
-            self.metrics.wal_append_seconds.observe(time.monotonic() - t0)
+            self.metrics.wal_append_seconds.observe(dt)
 
     def write_sync(self, msg: object) -> None:
         """Append + fsync (internal msgs and #ENDHEIGHT use this)."""
         self.write(msg)
         if self.is_running:
             t0 = time.monotonic()
-            with trace.span("wal.fsync"):
+            with trace.span("wal.fsync", height=self._height):
                 self.group.sync()
+            dt = time.monotonic() - t0
+            self._account("fsync", dt)
             if self.metrics is not None:
-                self.metrics.wal_fsync_seconds.observe(time.monotonic() - t0)
+                self.metrics.wal_fsync_seconds.observe(dt)
 
     def on_start(self) -> None:
         self.group.maybe_rotate()
@@ -198,6 +240,14 @@ class NilWAL:
     def write(self, msg) -> None: ...
 
     def write_sync(self, msg) -> None: ...
+
+    def set_height(self, height: int) -> None: ...
+
+    def height_costs(self, height: int):
+        return None
+
+    def pop_height_costs(self, height: int):
+        return None
 
     def start(self) -> None: ...
 

@@ -693,6 +693,15 @@ class NodeMetrics:
             "consensus_wal_fsync_seconds", "WAL fsync wall seconds",
             buckets=[b / 10 for b in _DEFAULT_BUCKETS],
         )
+        # commit-latency waterfall (libs/critpath.py): wall seconds each
+        # committed height spent in each phase of the commit path
+        self.height_phase_seconds = r.histogram(
+            "consensus_height_phase_seconds",
+            "Per-committed-height wall seconds attributed to each "
+            "commit-path phase by the critical-path analyzer",
+            buckets=[b / 10 for b in _DEFAULT_BUCKETS],
+            label_names=("phase",),
+        )
         # liveness watchdog (libs/watchdog.py)
         self.stalls = r.counter(
             "consensus_stalls_total",

@@ -1507,14 +1507,36 @@ class VoteFeed:
         self.rows_out += len(rows)
         self.flushes[reason] = self.flushes.get(reason, 0) + 1
         try:
-            get_profiler().record(
-                self.profile_kind,
-                lanes_present=verdict.lanes_present,
-                lanes_dispatched=verdict.lanes_dispatched,
-                heights=len(rows),
-                run_seconds=seconds,
-                n_windows=len(chunks),
-            )
+            # group keys lead with the vote height ((height, round, type) —
+            # state._maybe_batch_vote); annotate the ledger entry with the
+            # batch's base height so the critpath analyzer can join
+            # verify-dispatch cost to the height it served
+            hs = sorted({
+                gk[0] for gk in by_key
+                if isinstance(gk, tuple) and gk and isinstance(gk[0], int)
+            })
+            prof = get_profiler()
+            if hs:
+                # entry "heights" = covered height span (profile.py window
+                # semantics), NOT the row count — the per-height join
+                # amortizes multi-height entries by this span
+                with prof.window(hs[0], heights=hs[-1] - hs[0] + 1):
+                    prof.record(
+                        self.profile_kind,
+                        lanes_present=verdict.lanes_present,
+                        lanes_dispatched=verdict.lanes_dispatched,
+                        run_seconds=seconds,
+                        n_windows=len(chunks),
+                    )
+            else:
+                prof.record(
+                    self.profile_kind,
+                    lanes_present=verdict.lanes_present,
+                    lanes_dispatched=verdict.lanes_dispatched,
+                    heights=len(rows),
+                    run_seconds=seconds,
+                    n_windows=len(chunks),
+                )
         except Exception:
             pass
         try:

@@ -149,6 +149,17 @@ class HTTPClient:
             params["capacity"] = capacity
         return self.call("flight_reset", **params)
 
+    def dump_critpath(self, limit: Optional[int] = None) -> dict:
+        return self.call(
+            "dump_critpath", **({"limit": limit} if limit is not None else {})
+        )
+
+    def critpath_reset(self, capacity: Optional[int] = None) -> dict:
+        return self.call(
+            "critpath_reset",
+            **({"capacity": capacity} if capacity is not None else {}),
+        )
+
     def dump_device_health(self) -> dict:
         return self.call("dump_device_health")
 

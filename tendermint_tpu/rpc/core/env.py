@@ -709,6 +709,37 @@ class RPCEnv:
         out["stall"] = wd.report() if wd is not None else None
         return out
 
+    def dump_critpath(self, limit=None) -> dict:
+        """Snapshot the per-height critical-path analyzer: commit-latency
+        waterfalls (libs/critpath.py) with per-phase seconds, the dominant
+        phase, and rolling per-phase p50/p99.  limit=N keeps the newest N
+        height waterfalls.  Gated like dump_flight — it is derived from the
+        same lifecycle stamps."""
+        self._require_unsafe()
+        if limit is not None:
+            limit = int(limit)
+            if limit < 0:
+                raise RPCError(-32602, "limit must be >= 0")
+        cs = self.node.consensus_state
+        out = cs.critpath.snapshot(limit)
+        # waterfalls only accrue while the flight recorder stamps heights
+        out["flight_enabled"] = cs.flight.enabled
+        if not out["node_id"]:
+            out["node_id"] = cs.flight.node_id
+        return out
+
+    def critpath_reset(self, capacity=None) -> dict:
+        """Clear the critical-path waterfall ring and its rolling phase
+        percentile windows; optionally resize the ring (capacity=N)."""
+        self._require_unsafe()
+        cp = self.node.consensus_state.critpath
+        if capacity is not None:
+            capacity = int(capacity)
+            if capacity < 1:
+                raise RPCError(-32602, "capacity must be >= 1")
+        cp.reset(capacity)
+        return {"capacity": cp.capacity}
+
     def dump_mempool_qos(self) -> dict:
         """Per-peer mempool admission ledger (token levels, drops by
         reason, mute state), lane occupancy, and the RPC broadcast

@@ -128,7 +128,7 @@ class SimNode:
             wal = WAL(wal_path, metrics=self.metrics)
         self.cs = ConsensusState(
             cfg.consensus, st.copy(), block_exec, self.block_store,
-            self.mempool, self.evpool, wal=wal,
+            self.mempool, self.evpool, wal=wal, metrics=self.metrics,
         )
         # [verify] vote_batch_window_ms > 0: batched live-vote verification
         # (same wiring as node/node.py; exposed so scenarios can assert the

@@ -72,6 +72,7 @@ class ScenarioResult:
     commit_hashes: List[Dict[int, str]]  # per node: height -> hash hex
     commit_rounds: List[Dict[int, int]]  # per node: height -> commit round
     flight_dumps: List[dict]
+    critpath_dumps: List[dict]  # per node: cs.critpath.snapshot()
     fault_summary: dict
     stall_reports: List[dict]
     marks: Dict[str, dict]
@@ -242,6 +243,7 @@ def run_scenario(scenario: Scenario, seed: Optional[int] = None) -> ScenarioResu
     commit_hashes: List[Dict[int, str]] = []
     commit_rounds: List[Dict[int, int]] = []
     flight_dumps: List[dict] = []
+    critpath_dumps: List[dict] = []
     stall_reports: List[dict] = []
     summary: dict = {}
     started = time.monotonic()
@@ -296,6 +298,7 @@ def run_scenario(scenario: Scenario, seed: Optional[int] = None) -> ScenarioResu
         commit_hashes = [n.committed_hashes() for n in nodes]
         commit_rounds = [n.commit_rounds() for n in nodes]
         flight_dumps = [n.cs.flight.snapshot() for n in nodes]
+        critpath_dumps = [n.cs.critpath.snapshot() for n in nodes]
         stall_reports = [
             n.watchdog.report() for n in nodes
             if n.watchdog is not None and n.watchdog.report() is not None
@@ -323,6 +326,7 @@ def run_scenario(scenario: Scenario, seed: Optional[int] = None) -> ScenarioResu
         commit_hashes=commit_hashes,
         commit_rounds=commit_rounds,
         flight_dumps=flight_dumps,
+        critpath_dumps=critpath_dumps,
         fault_summary=summary,
         stall_reports=stall_reports,
         marks=run.marks,

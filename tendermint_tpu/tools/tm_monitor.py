@@ -61,6 +61,53 @@ def _sum_family(metrics: Dict[str, float], name: str) -> float:
     return total
 
 
+# commit-path phase -> CRIT column abbreviation (critpath.PHASES order)
+_CRIT_ABBREV = {
+    "propose_wait": "prop",
+    "block_parts": "parts",
+    "prevote_quorum": "prevote",
+    "precommit_quorum": "precommit",
+    "wal_append": "wal",
+    "wal_fsync": "fsync",
+    "abci_exec": "exec",
+    "commit_persist": "persist",
+}
+
+
+def _phase_label(key: str, family: str) -> Optional[str]:
+    """Extract phase="..." from `family{...}` series keys."""
+    if not key.startswith(family + "{"):
+        return None
+    i = key.find('phase="')
+    if i < 0:
+        return None
+    j = key.find('"', i + 7)
+    return key[i + 7 : j] if j > i else None
+
+
+def _crit_column(metrics: Dict[str, float]) -> str:
+    """Dominant commit-path phase from the height_phase_seconds family:
+    `phase avg_ms` where avg is the per-height mean of the phase with the
+    largest accumulated seconds; "-" when the family has no samples."""
+    fam = "tendermint_consensus_height_phase_seconds"
+    sums: Dict[str, float] = {}
+    counts: Dict[str, float] = {}
+    for k, v in metrics.items():
+        phase = _phase_label(k, fam + "_sum")
+        if phase is not None:
+            sums[phase] = sums.get(phase, 0.0) + v
+            continue
+        phase = _phase_label(k, fam + "_count")
+        if phase is not None:
+            counts[phase] = counts.get(phase, 0.0) + v
+    live = {p: s for p, s in sums.items() if counts.get(p, 0) > 0}
+    if not live:
+        return "-"
+    top = max(live, key=live.get)
+    avg_ms = 1e3 * live[top] / counts[top]
+    return f"{_CRIT_ABBREV.get(top, top)} {avg_ms:.0f}ms"
+
+
 class NodeMonitor:
     """One node's live stats (monitor/node.go)."""
 
@@ -83,6 +130,10 @@ class NodeMonitor:
         # device-guard columns (tendermint_verify_device_*)
         self.device_state = -1  # -1 unknown, else breaker gauge code
         self.device_fallbacks = 0
+        # critical-path column (tendermint_consensus_height_phase_seconds):
+        # dominant commit-path phase + its mean per-height cost, or "-"
+        # when the critpath analyzer has no samples (flight recorder off)
+        self.crit = "-"
         self._last_block_at: Optional[float] = None
         self._started = time.monotonic()
         self._online_time = 0.0
@@ -146,6 +197,7 @@ class NodeMonitor:
         self.device_fallbacks = int(
             _sum_family(m, "tendermint_verify_device_fallback_total")
         )
+        self.crit = _crit_column(m)
 
     def _connect_ws(self) -> None:
         try:
@@ -199,6 +251,7 @@ class NodeMonitor:
             "stall_seconds": self.stall_seconds,
             "device_state": self.device_state,
             "device_fallbacks": self.device_fallbacks,
+            "crit": self.crit,
             "uptime_pct": self.uptime_pct,
         }
 
@@ -279,8 +332,8 @@ def main(argv=None) -> int:
                       f"({snap['num_online']}/{snap['num_nodes']} online, "
                       f"height {snap['max_height']})")
                 print(f"{'MONIKER':<16}{'HEIGHT':>8}{'INTERVAL':>10}"
-                      f"{'VERIFY':>9}{'DEVICE':>10}{'TRAFFIC':>10}"
-                      f"{'STALL':>9}{'UPTIME':>8}  ADDR")
+                      f"{'VERIFY':>9}{'DEVICE':>10}{'CRIT':>15}"
+                      f"{'TRAFFIC':>10}{'STALL':>9}{'UPTIME':>8}  ADDR")
                 for n in snap["nodes"]:
                     if n["online"]:
                         suffix = ""
@@ -301,6 +354,7 @@ def main(argv=None) -> int:
                         f"{n['block_interval_ms']:>9}ms"
                         f"{n['verify_ms']:>7}ms"
                         f"{_fmt_device(n['device_state'], n['device_fallbacks']):>10}"
+                        f"{n['crit']:>15}"
                         f"{_fmt_bytes(n['traffic_bytes']):>10}"
                         f"{stall:>9}"
                         f"{n['uptime_pct']:>7}%  "

@@ -133,6 +133,83 @@ class TestFlightRecorder:
         with pytest.raises(ValueError):
             fr.reset(capacity=0)
 
+    def test_ring_wraparound_mid_height_consistency(self):
+        """Hooks keep landing on EVICTED heights after the ring wraps;
+        records(limit)/evicted()/snapshot() must stay mutually consistent
+        (the old snapshot took the lock three separate times, so a hook
+        firing between acquisitions could ship truncated=False next to a
+        record list that WAS truncated)."""
+        fr = FlightRecorder(capacity=3, enabled=True)
+        for h in (1, 2, 3, 4, 5):
+            fr.on_new_round(h, 0)
+        # late vote for an evicted height re-allocates it mid-wrap: height 1
+        # re-enters the ring, evicting height 3
+        fr.on_vote(1, 0, "prevote", "straggler", 0)
+        assert len(fr) == 3
+        assert fr.evicted() == 3
+        assert [r["height"] for r in fr.records()] == [1, 4, 5]
+        snap = fr.snapshot()
+        assert snap["total_records"] == 3
+        assert snap["evicted"] == 3
+        assert snap["truncated"] is False
+        assert len(snap["records"]) == snap["total_records"]
+        cut = fr.snapshot(limit=2)
+        assert cut["truncated"] is True
+        assert [r["height"] for r in cut["records"]] == [4, 5]
+        assert cut["total_records"] == 3 and cut["evicted"] == 3
+        # limit >= total: nothing cut, flag must say so
+        assert fr.snapshot(limit=3)["truncated"] is False
+        assert fr.snapshot(limit=99)["truncated"] is False
+
+    def test_snapshot_consistent_under_concurrent_wrap(self):
+        """dump_flight's payload must be internally consistent while hooks
+        wrap the ring from another thread: each snapshot's truncated flag
+        is derived from the SAME locked view as its record list."""
+        import threading
+
+        fr = FlightRecorder(capacity=4, enabled=True)
+        stop = threading.Event()
+
+        def hammer():
+            h = 0
+            while not stop.is_set():
+                h += 1
+                fr.on_new_round(h, 0)
+                fr.on_vote(h, 0, "prevote", "p", 0)
+                fr.on_commit(h, 0, b"\xaa")
+
+        t = threading.Thread(target=hammer, daemon=True)
+        t.start()
+        try:
+            last_evicted = 0
+            for _ in range(300):
+                snap = fr.snapshot(limit=2)
+                assert snap["total_records"] <= 4
+                assert len(snap["records"]) <= 2
+                assert snap["truncated"] is (
+                    len(snap["records"]) < snap["total_records"]
+                )
+                assert snap["evicted"] >= last_evicted  # monotone
+                last_evicted = snap["evicted"]
+                full = fr.snapshot()
+                assert full["truncated"] is False
+                assert len(full["records"]) == full["total_records"]
+        finally:
+            stop.set()
+            t.join(5.0)
+
+    def test_persist_hook_records_span(self):
+        fr = FlightRecorder(enabled=True)
+        fr.on_commit(7, 0, b"\xaa")
+        fr.on_persist(7, 1_000, 3_500)
+        (rec,) = fr.records()
+        assert rec["persist"] == {"t": 1_000, "dur_ns": 2_500}
+        assert fr.peek(7)["persist"]["dur_ns"] == 2_500
+        assert fr.peek(99) is None
+        # peek hands out a copy, not the live record
+        fr.peek(7)["persist"]["dur_ns"] = -1
+        assert fr.peek(7)["persist"]["dur_ns"] == 2_500
+
     def test_from_env(self, monkeypatch):
         monkeypatch.setenv("TM_FLIGHT", "1")
         monkeypatch.setenv("TM_FLIGHT_BUFFER", "16")
