@@ -57,9 +57,15 @@ bench_multisig:
 	$(PYTHON) scripts/bench_multisig.py 1000 3 5
 
 # mempool ingestion: serial vs micro-batched CheckTx, QoS decision rate,
-# recheck throughput; headline metric is mempool_checktx_per_s
+# recheck throughput (headline mempool_checktx_per_s), then the signed-tx
+# workload: app-serial ed25519 verify vs TxFeed planner dispatch with
+# in-bench admit/reject bit-parity + >=3x floor; appends a MEMPOOL_rNN.json
+# round and gates mempool_signed_checktx_per_s against the previous one
 mempool-bench:
 	JAX_PLATFORMS=cpu $(PYTHON) scripts/bench_mempool.py $(ARGS)
+	JAX_PLATFORMS=cpu $(PYTHON) scripts/bench_mempool.py --signed
+	$(PYTHON) scripts/bench_check.py --prefix MEMPOOL \
+	  --metric mempool_signed_checktx_per_s:0.25:higher
 
 # multi-client light-client frontend vs per-client serial verification
 lite-bench:

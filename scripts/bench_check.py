@@ -58,6 +58,9 @@ DEFAULT_METRICS = [
     # (scripts/bench_commit_path.py / make critpath-bench —
     # CRITPATH_r*.json rounds via --prefix); latency: lower is better
     "commit_p99_seconds:0.25:lower",
+    # batched signed-tx ingest headline (scripts/bench_mempool.py --signed /
+    # make mempool-bench ARGS=--signed — MEMPOOL_r*.json rounds via --prefix)
+    "mempool_signed_checktx_per_s:0.25:higher",
 ]
 DEFAULT_THRESHOLD = 0.20
 

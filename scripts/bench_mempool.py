@@ -5,13 +5,25 @@ recheck throughput.
 Emits one JSON line per stage and a final combined line whose headline is
 ``mempool_checktx_per_s`` — the metric `make bench-check` gates on.
 
-Usage: python scripts/bench_mempool.py [N_TXS] [BATCH] [--metrics-out PATH]
+``--signed`` switches to the signed-transaction workload (SignedKVStoreApp):
+serial = the app verifies each ed25519 signature inline in CheckTx; batched =
+the mempool pre-verifies whole admission windows on a planner TxFeed dispatch
+(mempool/tx_verify.py) and the app trusts the verdict hint.  The stage
+asserts in-bench that (a) admit/reject codes on a mixed valid/garbage/
+wrong-nonce/mutant stream are bit-identical to the serial path and (b) the
+batched path clears 3x serial — then emits ``mempool_signed_checktx_per_s``,
+the gated metric.
+
+Usage: python scripts/bench_mempool.py [N_TXS] [BATCH] [--signed]
+                                       [--metrics-out PATH]
 """
 
 from __future__ import annotations
 
+import glob
 import json
 import os
+import re
 import sys
 import time
 
@@ -32,6 +44,8 @@ from tendermint_tpu.proxy.app_conn import (  # noqa: E402
 N_TXS = 20_000
 BATCH = 64
 QOS_DECISIONS = 200_000
+N_SIGNED = 512  # ed25519 serial verify is ~ms each; 512 keeps serial honest
+SIGNED_BATCH = 128
 
 
 def make_mempool(n: int, metrics=None, **kw) -> Mempool:
@@ -84,10 +98,175 @@ def recheck_rate(n: int, window: int) -> float:
     return n / dt
 
 
+# -- signed-transaction workload ------------------------------------------
+
+
+def _make_signed_mempool(app, n: int, metrics=None, **kw):
+    conn = MultiAppConn(LocalClientCreator(app))
+    conn.start()
+    return Mempool(
+        conn.mempool, size=4 * n, cache_size=4 * n, metrics=metrics, **kw
+    )
+
+
+def _push_and_settle(mp, txs, codes):
+    """Admit every tx and return when every CheckTx code has landed —
+    including the partial trailing window, flushed explicitly so the timed
+    region never waits out the batch timer."""
+    from tendermint_tpu.mempool.mempool import MempoolError
+
+    def mk_cb(i):
+        return lambda res: codes.__setitem__(i, res.code)
+
+    for i, tx in enumerate(txs):
+        try:
+            mp.check_tx(tx, mk_cb(i))
+        except MempoolError:
+            codes[i] = -1  # rejected before the app saw it (cache/size)
+    mp._flush_checktx_batch()
+    deadline = time.perf_counter() + 60
+    while any(c is None for c in codes):
+        if time.perf_counter() > deadline:
+            raise RuntimeError("CheckTx callbacks did not settle")
+        time.sleep(0.001)
+
+
+def signed_checktx_rates(n: int, batch: int, metrics=None):
+    """(serial tx/s, batched tx/s, feed) for the signed workload, plus an
+    in-bench bit-parity assertion of admit/reject codes on a mixed stream."""
+    from tendermint_tpu.abci.examples.kvstore import (
+        SignedKVStoreApp,
+        extract_signed_tx_sig,
+        make_signed_tx,
+    )
+    from tendermint_tpu.crypto.keys import PrivKeyEd25519
+    from tendermint_tpu.mempool.tx_verify import BatchTxVerifier
+    from tendermint_tpu.parallel.planner import TxFeed
+
+    # 64 senders x n/64 sequential nonces; signing happens outside the
+    # timed region
+    n_keys = min(64, n)
+    privs = [
+        PrivKeyEd25519.generate(b"bench-signed-%03d" % i + b"\x00" * 16)
+        for i in range(n_keys)
+    ]
+    txs = [
+        make_signed_tx(privs[i % n_keys], i // n_keys + 1,
+                       b"sb%07d=v" % i)
+        for i in range(n)
+    ]
+    # mixed parity stream: valid / garbage sig / wrong nonce / mutant payload
+    mixed = []
+    for i in range(n_keys):
+        nonce = n // n_keys + 1
+        mixed.append(make_signed_tx(privs[i], nonce, b"mx%04d=v" % i))
+        garbage = bytearray(
+            make_signed_tx(privs[i], nonce + 1, b"mg%04d=v" % i))
+        garbage[-8] ^= 0x55
+        mixed.append(bytes(garbage))
+        mixed.append(make_signed_tx(privs[i], nonce + 77, b"mw%04d=v" % i))
+        mutant = bytearray(
+            make_signed_tx(privs[i], nonce + 1, b"mm%04d=v" % i))
+        mutant[-1] ^= 0x01
+        mixed.append(bytes(mutant))
+
+    def run(use_feed):
+        app = SignedKVStoreApp()
+        feed = None
+        if use_feed:
+            mp = _make_signed_mempool(
+                app, n, metrics=metrics, lane_bounds=(1, 1024),
+                checktx_batch=batch, checktx_batch_wait=0.05,
+            )
+            feed = TxFeed(window_s=0.005, max_rows=64)
+            mp.set_batch_check_hook(
+                BatchTxVerifier(feed, extract_signed_tx_sig,
+                                height_fn=mp.height),
+                verdicts=True,
+            )
+        else:
+            mp = _make_signed_mempool(
+                app, n, metrics=metrics, checktx_batch=1)
+        codes = [None] * n
+        t0 = time.perf_counter()
+        _push_and_settle(mp, txs, codes)
+        dt = time.perf_counter() - t0
+        assert all(c == 0 for c in codes), "valid signed tx rejected"
+        assert mp.size() == n, f"admitted {mp.size()}/{n}"
+        mixed_codes = [None] * len(mixed)
+        _push_and_settle(mp, mixed, mixed_codes)
+        if feed is not None:
+            assert feed.dispatches > 0, "tx feed never engaged"
+            feed.close()
+        return n / dt, mixed_codes, app.serial_verifies
+
+    serial_rate, serial_mixed, _ = run(use_feed=False)
+    batched_rate, batched_mixed, batched_serial_verifies = run(use_feed=True)
+    # the acceptance bar: same admit/reject verdict for every tx, and the
+    # feed (not the app) did the signature work on the batched run
+    assert batched_mixed == serial_mixed, (
+        "signed CheckTx verdicts diverged from the serial path: "
+        f"{serial_mixed} vs {batched_mixed}"
+    )
+    assert batched_serial_verifies == 0, (
+        f"app fell back to {batched_serial_verifies} serial verifies"
+    )
+    return serial_rate, batched_rate
+
+
 def main() -> int:
     metrics_out = pop_metrics_out()
-    n = int(sys.argv[1]) if len(sys.argv) > 1 else N_TXS
-    batch = int(sys.argv[2]) if len(sys.argv) > 2 else BATCH
+    signed = "--signed" in sys.argv
+    if signed:
+        sys.argv.remove("--signed")
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else (
+        N_SIGNED if signed else N_TXS)
+    batch = int(sys.argv[2]) if len(sys.argv) > 2 else (
+        SIGNED_BATCH if signed else BATCH)
+
+    if signed:
+        metrics = NodeMetrics()
+        serial, batched = signed_checktx_rates(n, batch, metrics=metrics)
+        print(json.dumps({"stage": "signed_checktx_serial",
+                          "tx_per_s": round(serial, 1)}), flush=True)
+        print(json.dumps({"stage": "signed_checktx_batched", "batch": batch,
+                          "tx_per_s": round(batched, 1)}), flush=True)
+        speedup = batched / serial
+        assert speedup >= 3.0, (
+            f"signed batched path only {speedup:.2f}x serial (need >= 3x)"
+        )
+        if metrics_out:
+            with open(metrics_out, "w") as f:
+                f.write(metrics.registry.expose_text())
+            print(f"# metrics snapshot -> {metrics_out}", file=sys.stderr)
+        parsed = {
+            "mempool_signed_checktx_per_s": round(batched, 1),
+            "mempool_signed_checktx_serial_per_s": round(serial, 1),
+            "batch": batch,
+            "n_txs": n,
+            "vs_serial": round(speedup, 2),
+            "parity": True,
+        }
+        tail = json.dumps({
+            "metric": "mempool_signed_checktx_per_s",
+            "value": round(batched, 1),
+            "unit": "tx/s",
+            **parsed,
+        })
+        print(tail, flush=True)
+        # append the next MEMPOOL_rNN.json round for bench_check --prefix
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        ns = [
+            int(m.group(1))
+            for p in glob.glob(os.path.join(root, "MEMPOOL_r*.json"))
+            if (m := re.search(r"MEMPOOL_r(\d+)\.json$", os.path.basename(p)))
+        ]
+        path = os.path.join(root, f"MEMPOOL_r{max(ns, default=0) + 1:02d}.json")
+        with open(path, "w") as f:
+            json.dump({"rc": 0, "tail": tail, "parsed": parsed}, f, indent=2)
+            f.write("\n")
+        print(f"# bench round -> {path}", file=sys.stderr)
+        return 0
 
     metrics = NodeMetrics()
     serial = checktx_rate(n, b"s", metrics=metrics, checktx_batch=1)

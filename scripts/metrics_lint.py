@@ -274,6 +274,7 @@ def _self_check():
     """Exercise labeled histograms and every escaping edge, then lint."""
     from tendermint_tpu.libs.metrics import (
         FrontendMetrics,
+        MempoolBatchMetrics,
         NodeMetrics,
         Registry,
         VerifyMetrics,
@@ -332,6 +333,12 @@ def _self_check():
     vbm.record_flush("deadline", 24, 64, 0.375)
     vbm.record_flush("quorum", 3, 64, 0.047)
     vbm.record_flush("close", 1, 8, 0.125)
+
+    mbm = MempoolBatchMetrics()
+    # tx-ingest feed shares the flush-reason vocabulary
+    mbm.record_flush("deadline", 48, 64, 0.75)
+    mbm.record_flush("quorum", 16, 64, 0.25)
+    mbm.record_flush("close", 2, 8, 0.25)
 
     nm = NodeMetrics()
     # exercise the hot-path families so the lint covers sample lines, not
@@ -467,11 +474,31 @@ def _self_check():
             ("vote-batch family parity",
              [f"missing family {n}" for n in missing_vb])
         )
+    # tx-ingest batcher family parity: MempoolBatchMetrics owns the names
+    # ([mempool] tx_batch_window_ms, parallel/planner.py TxFeed as driven
+    # by mempool/tx_verify.py) and NodeMetrics attaches the singleton
+    mempool_batch_names = (
+        "tendermint_mempool_batch_rows",
+        "tendermint_mempool_batch_lanes",
+        "tendermint_mempool_batch_lane_occupancy",
+        "tendermint_mempool_batch_flush_total",
+    )
+    mb_text = mbm.registry.expose_text()
+    missing_mb = [
+        n for n in mempool_batch_names
+        if f"# TYPE {n} " not in mb_text or f"# TYPE {n} " not in node_text
+    ]
+    if missing_mb:
+        failures.append(
+            ("mempool-batch family parity",
+             [f"missing family {n}" for n in missing_mb])
+        )
     for label, text in (
         ("escaping registry", r.expose_text()),
         ("VerifyMetrics", vm.registry.expose_text()),
         ("FrontendMetrics", frontend_text),
         ("VoteBatchMetrics", vb_text),
+        ("MempoolBatchMetrics", mb_text),
         ("NodeMetrics(+verify attached)", node_text),
     ):
         errs = lint_text(text)

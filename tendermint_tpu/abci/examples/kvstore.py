@@ -6,6 +6,12 @@ persistent_kvstore.go, counter/counter.go).
     changes via 'val:<pubkey_b64>!<power>' txs
   * CounterApp           — serial-number counter exercising CheckTx/DeliverTx
     validation split
+  * SignedKVStoreApp     — signature-bearing kvstore workload: every tx
+    carries a sender pubkey (ed25519 or secp256k1), a per-sender nonce and
+    a signature over canonical sign-bytes, checked on CheckTx AND
+    DeliverTx.  The millions-of-users ingest workload the batched-CheckTx
+    path (mempool/tx_verify.py + parallel/planner.TxFeed) is measured
+    against.
 """
 
 from __future__ import annotations
@@ -116,6 +122,214 @@ class PriorityKVStoreApp(KVStoreApp):
         return abci.ResponseCheckTx(
             code=abci.CODE_TYPE_OK, priority=self.tx_priority(req.tx)
         )
+
+
+# ---------------------------------------------------------------------------
+# Signed-transaction workload (batched-ingest tentpole)
+# ---------------------------------------------------------------------------
+
+# wire format (all integers big-endian):
+#   tx         = MAGIC | algo(1) | publen(1) | pub | nonce(8) |
+#                siglen(2) | sig | payload
+#   sign_bytes = MAGIC | algo(1) | publen(1) | pub | nonce(8) | payload
+# i.e. the canonical sign-bytes are exactly the tx minus its signature
+# field, so a tx is its own verification witness and any payload or nonce
+# mutation invalidates the signature.
+SIGNED_TX_MAGIC = b"stx1"
+ALGO_ED25519 = 0
+ALGO_SECP256K1 = 1
+
+# CheckTx/DeliverTx reject codes (nonzero = rejected; the mempool treats
+# any nonzero code identically, the split exists for tests and operators)
+CODE_BAD_TX = 0x51  # undecodable / wrong magic / bad lengths
+CODE_BAD_SIG = 0x52  # signature does not verify over the sign-bytes
+CODE_BAD_NONCE = 0x53  # nonce is not exactly last-seen + 1 for the sender
+
+
+class SignedTx:
+    """Decoded signed transaction (see the wire format above)."""
+
+    __slots__ = ("algo", "pub", "nonce", "sig", "payload", "sign_bytes")
+
+    def __init__(self, algo, pub, nonce, sig, payload, sign_bytes):
+        self.algo = algo
+        self.pub = pub
+        self.nonce = nonce
+        self.sig = sig
+        self.payload = payload
+        self.sign_bytes = sign_bytes
+
+
+def signed_tx_sign_bytes(algo: int, pub: bytes, nonce: int,
+                         payload: bytes) -> bytes:
+    """Canonical sign-bytes: deterministic, length-prefixed, and equal to
+    the encoded tx with the signature field removed."""
+    return (SIGNED_TX_MAGIC + bytes([algo, len(pub)]) + pub
+            + struct.pack(">Q", nonce) + payload)
+
+
+def encode_signed_tx(algo: int, pub: bytes, nonce: int, sig: bytes,
+                     payload: bytes) -> bytes:
+    return (SIGNED_TX_MAGIC + bytes([algo, len(pub)]) + pub
+            + struct.pack(">Q", nonce) + struct.pack(">H", len(sig)) + sig
+            + payload)
+
+
+def make_signed_tx(priv, nonce: int, payload: bytes) -> bytes:
+    """Sign `payload` with a keys.py private key (PrivKeyEd25519 or
+    PrivKeySecp256k1) — the workload generator for benches and tests."""
+    from tendermint_tpu.crypto.keys import PrivKeySecp256k1
+
+    algo = (ALGO_SECP256K1 if isinstance(priv, PrivKeySecp256k1)
+            else ALGO_ED25519)
+    pub = priv.pub_key().bytes()
+    sig = priv.sign(signed_tx_sign_bytes(algo, pub, nonce, payload))
+    return encode_signed_tx(algo, pub, nonce, sig, payload)
+
+
+def decode_signed_tx(tx: bytes) -> Optional[SignedTx]:
+    """None on any structural defect — the app rejects with CODE_BAD_TX and
+    the mempool's signature extractor leaves the verdict to the app."""
+    if len(tx) < len(SIGNED_TX_MAGIC) + 2 or not tx.startswith(SIGNED_TX_MAGIC):
+        return None
+    off = len(SIGNED_TX_MAGIC)
+    algo = tx[off]
+    publen = tx[off + 1]
+    off += 2
+    if algo == ALGO_ED25519:
+        if publen != 32:
+            return None
+    elif algo == ALGO_SECP256K1:
+        if publen != 33:
+            return None
+    else:
+        return None
+    if len(tx) < off + publen + 8 + 2:
+        return None
+    pub = tx[off:off + publen]
+    off += publen
+    (nonce,) = struct.unpack_from(">Q", tx, off)
+    off += 8
+    (siglen,) = struct.unpack_from(">H", tx, off)
+    off += 2
+    if len(tx) < off + siglen:
+        return None
+    sig = tx[off:off + siglen]
+    payload = tx[off + siglen:]
+    return SignedTx(
+        algo, pub, nonce, sig, payload,
+        signed_tx_sign_bytes(algo, pub, nonce, payload),
+    )
+
+
+def extract_signed_tx_sig(tx: bytes):
+    """Mempool signature extractor (Mempool.set_batch_check_hook seam):
+    ``tx -> (PubKey, sign_bytes, sig)`` or None when the tx is not a
+    well-formed signed tx (the app then decides the whole verdict
+    serially).  Returns keys.py PubKey objects so the planner's device
+    gate and verify_generic dispatch each algo to its backend —
+    secp256k1 lanes push the window down the host path, bit-identically."""
+    stx = decode_signed_tx(tx)
+    if stx is None:
+        return None
+    from tendermint_tpu.crypto.keys import PubKeyEd25519, PubKeySecp256k1
+
+    if stx.algo == ALGO_ED25519:
+        pk = PubKeyEd25519(stx.pub)
+    else:
+        pk = PubKeySecp256k1(stx.pub)
+    return pk, stx.sign_bytes, stx.sig
+
+
+class SignedKVStoreApp(KVStoreApp):
+    """KVStore over signed transactions: CheckTx and DeliverTx verify the
+    sender signature and enforce strictly-sequential per-sender nonces, so
+    mempool admission actually pays signature verification — the workload
+    the batched ingest path (`[mempool] tx_batch_window_ms`) accelerates.
+
+    ``RequestCheckTx.sig_verified`` is the batched-verdict hint: when the
+    mempool already verified the signature on a planner dispatch (which is
+    bit-identical to `_verify_sig` by the planner's accept/reject
+    contract), the app trusts the verdict and skips its own serial check;
+    None (no batcher, feed error, structurally odd tx) keeps the serial
+    path.  DeliverTx always verifies — block execution trusts nobody.
+
+    Payloads are the plain kvstore `key=value` form with the PriorityKVStore
+    ``pri<N>:`` prefix honored for mempool lane tests."""
+
+    def __init__(self):
+        super().__init__()
+        self.nonces: Dict[bytes, int] = {}  # committed per-sender nonce
+        # CheckTx overlay: nonces admitted this block, reset at commit so
+        # the post-commit recheck replays survivors against fresh state
+        self._check_nonces: Dict[bytes, int] = {}
+        self.serial_verifies = 0  # serial signature checks actually paid
+
+    tx_sig_extractor = staticmethod(extract_signed_tx_sig)
+    tx_priority = staticmethod(PriorityKVStoreApp.tx_priority)
+
+    def _verify_sig(self, stx: SignedTx) -> bool:
+        self.serial_verifies += 1
+        if stx.algo == ALGO_ED25519:
+            from tendermint_tpu.crypto import ed25519 as _ed
+
+            return _ed.verify(stx.pub, stx.sign_bytes, stx.sig)
+        # secp256k1 premix mirrors crypto/batch.HostBatchVerifier
+        # (secp256k1.go:140: sign/verify over SHA-256 of the message)
+        from tendermint_tpu.crypto import secp256k1 as _secp
+        from tendermint_tpu.crypto.hashing import sha256
+
+        return _secp.verify(stx.pub, sha256(stx.sign_bytes), stx.sig)
+
+    def check_tx(self, req: abci.RequestCheckTx) -> abci.ResponseCheckTx:
+        stx = decode_signed_tx(req.tx)
+        if stx is None:
+            return abci.ResponseCheckTx(
+                code=CODE_BAD_TX, log="malformed signed tx"
+            )
+        verified = getattr(req, "sig_verified", None)
+        ok = verified if verified is not None else self._verify_sig(stx)
+        if not ok:
+            return abci.ResponseCheckTx(
+                code=CODE_BAD_SIG, log="invalid signature"
+            )
+        expected = self._check_nonces.get(
+            stx.pub, self.nonces.get(stx.pub, 0)
+        ) + 1
+        if stx.nonce != expected:
+            return abci.ResponseCheckTx(
+                code=CODE_BAD_NONCE,
+                log=f"bad nonce {stx.nonce}, want {expected}",
+            )
+        self._check_nonces[stx.pub] = stx.nonce
+        return abci.ResponseCheckTx(
+            code=abci.CODE_TYPE_OK, priority=self.tx_priority(stx.payload)
+        )
+
+    def deliver_tx(self, req: abci.RequestDeliverTx) -> abci.ResponseDeliverTx:
+        stx = decode_signed_tx(req.tx)
+        if stx is None:
+            return abci.ResponseDeliverTx(
+                code=CODE_BAD_TX, log="malformed signed tx"
+            )
+        if not self._verify_sig(stx):
+            return abci.ResponseDeliverTx(
+                code=CODE_BAD_SIG, log="invalid signature"
+            )
+        expected = self.nonces.get(stx.pub, 0) + 1
+        if stx.nonce != expected:
+            return abci.ResponseDeliverTx(
+                code=CODE_BAD_NONCE,
+                log=f"bad nonce {stx.nonce}, want {expected}",
+            )
+        self.nonces[stx.pub] = stx.nonce
+        return super().deliver_tx(
+            abci.RequestDeliverTx(tx=stx.payload)
+        )
+
+    def commit(self, req: abci.RequestCommit) -> abci.ResponseCommit:
+        self._check_nonces = {}
+        return super().commit(req)
 
 
 class PersistentKVStoreApp(KVStoreApp):

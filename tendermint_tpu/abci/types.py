@@ -185,6 +185,11 @@ class RequestBeginBlock:
 @dataclass
 class RequestCheckTx:
     tx: bytes = b""
+    # batched-ingest hint (mempool/tx_verify.py): True/False = the mempool
+    # already verified this tx's signature on a planner dispatch
+    # (bit-identical to the app's own check), None = unknown — the app
+    # must verify serially.  Apps without signatures ignore it.
+    sig_verified: Optional[bool] = None
 
 
 @dataclass

@@ -128,6 +128,14 @@ class MempoolConfig:
     # for the whole round).
     checktx_batch: int = 1
     recheck_batch: int = 0
+    # -- batched signature ingest: when > 0 and the app exposes a
+    # `tx_sig_extractor`, CheckTx/recheck windows pre-verify tx signatures
+    # on a planner TxFeed dispatch (mempool/tx_verify.py) instead of one
+    # serial verify per tx inside the app.  window_ms bounds how long the
+    # feed may coalesce rows from concurrent callers; rows caps txs per
+    # lane row.  0 disables (reference behavior: app verifies serially).
+    tx_batch_window_ms: float = 0.0
+    tx_batch_rows: int = 64
 
 
 @dataclass

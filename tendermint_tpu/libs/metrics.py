@@ -643,6 +643,60 @@ def get_vote_batch_metrics() -> VoteBatchMetrics:
         return _vote_batch_metrics
 
 
+class MempoolBatchMetrics:
+    """Ingest micro-batcher telemetry (parallel/planner.TxFeed): how many
+    CheckTx-window rows fold into each flush, how full the lane tile is,
+    and what triggered the flush (deadline|quorum|close).  Process-wide
+    like VoteBatchMetrics — the feed is one worker per process regardless
+    of how many CheckTx windows feed it."""
+
+    def __init__(self, registry: Optional[Registry] = None):
+        r = registry or Registry()
+        self.registry = r
+        self.batch_rows = r.histogram(
+            "mempool_batch_rows",
+            "CheckTx-window rows folded into one batched tx-verify dispatch",
+            buckets=_SIZE_BUCKETS,
+        )
+        self.batch_lanes = r.histogram(
+            "mempool_batch_lanes",
+            "Txs (present lanes) per batched tx-verify dispatch",
+            buckets=_SIZE_BUCKETS,
+        )
+        self.lane_occupancy = r.histogram(
+            "mempool_batch_lane_occupancy",
+            "Lane occupancy (present/dispatched) of batched tx dispatches",
+            buckets=(0.05, 0.1, 0.25, 0.5, 0.75, 0.9, 1.0),
+        )
+        self.flushes = r.counter(
+            "mempool_batch_flush_total",
+            "Tx micro-batcher flushes by trigger (deadline|quorum|close)",
+            label_names=("reason",),
+        )
+
+    def record_flush(self, reason: str, rows: int, lanes: int,
+                     occupancy: float) -> None:
+        """One TxFeed flush: shape + trigger in one call."""
+        self.batch_rows.observe(float(rows))
+        self.batch_lanes.observe(float(lanes))
+        self.lane_occupancy.observe(float(occupancy))
+        self.flushes.add(1.0, (reason,))
+
+
+_mempool_batch_mtx = threading.Lock()
+_mempool_batch_metrics: Optional[MempoolBatchMetrics] = None
+
+
+def get_mempool_batch_metrics() -> MempoolBatchMetrics:
+    """Process-wide MempoolBatchMetrics singleton (mirrors
+    get_vote_batch_metrics)."""
+    global _mempool_batch_metrics
+    with _mempool_batch_mtx:
+        if _mempool_batch_metrics is None:
+            _mempool_batch_metrics = MempoolBatchMetrics()
+        return _mempool_batch_metrics
+
+
 class NodeMetrics:
     """All four reference metric families on one registry
     (consensus/metrics.go:14, p2p/metrics.go, mempool/metrics.go,
@@ -808,6 +862,8 @@ class NodeMetrics:
         r.attach(self.frontend.registry)
         self.vote_batch = get_vote_batch_metrics()
         r.attach(self.vote_batch.registry)
+        self.mempool_batch = get_mempool_batch_metrics()
+        r.attach(self.mempool_batch.registry)
         self._last_block_time: Optional[float] = None
         # cardinality hygiene: at most MAX_PEER_LABELS distinct peer ids ever
         # get their own label value; the rest collapse into "overflow"

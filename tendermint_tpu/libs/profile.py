@@ -21,10 +21,14 @@ planner's execute paths, ``"frontend.verify_batch"`` for flushes of the
 light-client frontend's cross-client aggregator (`parallel/planner.py
 LaneFeed` as wired by `frontend/frontend.py`) — there ``heights`` counts
 the client rows folded into the flush, not consecutive block heights —
-and ``"consensus.vote_batch"`` for flushes of the live-vote micro-batcher
+``"consensus.vote_batch"`` for flushes of the live-vote micro-batcher
 (`parallel/planner.VoteFeed`), where ``heights`` counts the vote-set rows
 the flush packed and ``n_windows`` the ≤max_rows windows folded into the
-superdispatch.
+superdispatch, and ``"mempool.tx_batch"`` for flushes of the CheckTx
+signature-ingest feed (`parallel/planner.TxFeed`) with the same row/window
+accounting — annotated with the mempool's current height so the critpath
+analyzer's ``verify_dispatch`` overlay picks the flush up in that height's
+commit waterfall.
 
 Like libs/trace.py this is deliberately dependency-free and cheap when
 idle: recording is a dict append under a lock, and the ring buffer bounds

@@ -22,7 +22,12 @@ CometBFT's priority mempool era:
   and flush timings land in the `libs/profile.py` dispatch ledger
   (``mempool.checktx_batch`` / ``mempool.recheck_batch`` entries), and
   ``batch_check_hook`` is the seam where planner-based batched signature
-  verification plugs in.
+  verification plugs in: observational by default,
+  ``set_batch_check_hook(hook, verdicts=True)`` upgrades it to the
+  verdict-bearing seam (mempool/tx_verify.BatchTxVerifier +
+  parallel/planner.TxFeed) — each window's app sends wait for the hook's
+  per-tx signature verdicts, which ride ``RequestCheckTx.sig_verified``
+  so the app never pays a serial verify the planner already paid.
 * **recheck cursor resync** — a tx removed mid-recheck (committed while
   responses were in flight) desynchronizes the cursor; the hash index is
   used to resynchronize instead of silently corrupting the walk.
@@ -162,9 +167,17 @@ class Mempool(MempoolIface):
         self._flush_timer: Optional[threading.Timer] = None
         # seam for planner-based batched signature verification: when set,
         # called with the list of raw txs in each CheckTx/recheck window
-        # before the flush that dispatches them
+        # before the flush that dispatches them.  Observational by default
+        # (the PR-8 contract); set_batch_check_hook(hook, verdicts=True)
+        # upgrades it to the verdict-bearing seam: the window's app sends
+        # are deferred until the hook returns its per-tx signature
+        # verdicts, which ride RequestCheckTx.sig_verified so the app
+        # skips its own serial verify.
         self.batch_check_hook: Optional[Callable[[List[bytes]], None]] = None
+        self._hook_verdicts = False
         self._batch_txs: List[bytes] = []
+        self._batch_cbs: List[Optional[Callable]] = []
+        self._proxy_takes_verdict: Optional[bool] = None
         import logging
 
         self.logger = logger or logging.getLogger("tm.mempool")
@@ -177,9 +190,52 @@ class Mempool(MempoolIface):
     def unlock(self) -> None:
         self._mtx.release()
 
+    def set_batch_check_hook(
+        self, hook: Optional[Callable], *, verdicts: bool = False
+    ) -> None:
+        """Install the CheckTx-window hook.
+
+        ``verdicts=False`` keeps the observational contract: the hook is
+        called with each window's raw txs, fire-and-forget, after the app
+        requests were already queued.  ``verdicts=True`` makes it the
+        verdict-bearing seam (mempool/tx_verify.BatchTxVerifier): the
+        window's ``check_tx_async`` sends are DEFERRED until the hook
+        returns a per-tx verdict list (True = signature verified good,
+        False = verified bad, None = unknown), and each verdict rides its
+        request's ``sig_verified`` field so the app skips its own serial
+        signature check.  Verdicts are advisory exactly as far as the
+        planner's bit-identical accept/reject contract reaches — the app
+        still owns the response (nonce/state checks, reject codes)."""
+        self.batch_check_hook = hook
+        self._hook_verdicts = bool(hook is not None and verdicts)
+
+    def _send_checktx(self, tx: bytes, sig_verified=None):
+        """One app-conn CheckTx send carrying the batched-verify verdict;
+        conns predating the hint (test fakes) get the bare call.  The
+        probe is by signature, not try/except: a local conn runs the app
+        inline, so a TypeError out of the app must not trigger a resend."""
+        if self._proxy_takes_verdict is None:
+            import inspect
+
+            try:
+                params = inspect.signature(
+                    self._proxy.check_tx_async
+                ).parameters
+                self._proxy_takes_verdict = "sig_verified" in params
+            except (TypeError, ValueError):
+                self._proxy_takes_verdict = False
+        if self._proxy_takes_verdict:
+            return self._proxy.check_tx_async(tx, sig_verified=sig_verified)
+        return self._proxy.check_tx_async(tx)
+
     # info -----------------------------------------------------------------
     def size(self) -> int:
         return len(self._txs)
+
+    def height(self) -> int:
+        """Height the pool last validated against (feeds the tx feed's
+        critpath height annotation)."""
+        return self._height
 
     def n_lanes(self) -> int:
         return len(self._lanes)
@@ -295,9 +351,14 @@ class Mempool(MempoolIface):
             if self._wal is not None:
                 self._wal.write(tx + b"\n")
                 self._wal.flush()
-            rr = self._proxy.check_tx_async(tx)
-            if callback is not None:
-                rr.set_callback(lambda req, res: callback(res))
+            if self._hook_verdicts:
+                # verdict-bearing seam: the app send waits for the flush,
+                # where the batched signature verdict rides the request
+                self._batch_cbs.append(callback)
+            else:
+                rr = self._proxy.check_tx_async(tx)
+                if callback is not None:
+                    rr.set_callback(lambda req, res: callback(res))
             if self._pending_flush == 0:
                 self._pending_since = time.perf_counter()
             self._pending_flush += 1
@@ -330,12 +391,40 @@ class Mempool(MempoolIface):
                 return
             self._pending_flush = 0
             batch_txs, self._batch_txs = self._batch_txs, []
+            batch_cbs, self._batch_cbs = self._batch_cbs, []
             if self._flush_timer is not None:
                 self._flush_timer.cancel()
                 self._flush_timer = None
             pack_s = time.perf_counter() - self._pending_since
-            if self.batch_check_hook is not None:
-                self.batch_check_hook(batch_txs)
+            hook = self.batch_check_hook
+            verdict_mode = self._hook_verdicts and hook is not None
+            if hook is not None and not verdict_mode:
+                hook(batch_txs)
+        if verdict_mode:
+            # hook OUTSIDE the lock: it blocks on the tx feed's flush
+            # window and admission must not hold the consensus Lock/Unlock
+            # boundary hostage for it
+            verdicts = None
+            try:
+                verdicts = hook(batch_txs)
+            except Exception:
+                self.logger.exception(
+                    "batch check hook failed; falling back to serial verify"
+                )
+            if verdicts is not None and len(verdicts) != len(batch_txs):
+                self.logger.error(
+                    "batch check hook returned %d verdicts for %d txs; "
+                    "ignored", len(verdicts), len(batch_txs),
+                )
+                verdicts = None
+            with self._mtx:
+                for i, tx in enumerate(batch_txs):
+                    rr = self._send_checktx(
+                        tx, None if verdicts is None else verdicts[i]
+                    )
+                    cb = batch_cbs[i] if i < len(batch_cbs) else None
+                    if cb is not None:
+                        rr.set_callback(lambda req, res, _cb=cb: _cb(res))
         t0 = time.perf_counter()
         self._proxy.flush_async()
         run_s = time.perf_counter() - t0
@@ -514,7 +603,10 @@ class Mempool(MempoolIface):
             # and mutate the list while we would still be walking it
             survivors = [memtx.tx for memtx in self._txs]
             for tx in survivors:
-                self._proxy.check_tx_async(tx)
+                if not self._hook_verdicts:
+                    self._proxy.check_tx_async(tx)
+                # verdict mode defers the send to the window flush, where
+                # the (cached) signature verdict rides along
                 sent.append(tx)
                 if len(sent) >= batch:
                     self._flush_recheck_batch(sent, t_pack)
@@ -525,8 +617,27 @@ class Mempool(MempoolIface):
         self._notify_txs_available()
 
     def _flush_recheck_batch(self, batch_txs: List[bytes], t_pack: float) -> None:
-        if self.batch_check_hook is not None:
-            self.batch_check_hook(batch_txs)
+        hook = self.batch_check_hook
+        if hook is not None and not self._hook_verdicts:
+            hook(batch_txs)
+        elif hook is not None:
+            # verdict-bearing recheck: survivors already passed admission,
+            # so the hook answers from its tx-hash verdict cache — rechecks
+            # re-run app state checks only, not signatures.  Sends stay in
+            # walk order so the recheck cursor's FIFO contract holds.
+            verdicts = None
+            try:
+                verdicts = hook(batch_txs)
+            except Exception:
+                self.logger.exception(
+                    "batch check hook failed on recheck; serial verify"
+                )
+            if verdicts is not None and len(verdicts) != len(batch_txs):
+                verdicts = None
+            for i, tx in enumerate(batch_txs):
+                self._send_checktx(
+                    tx, None if verdicts is None else verdicts[i]
+                )
         pack_s = time.perf_counter() - t_pack
         t0 = time.perf_counter()
         self._proxy.flush_async()

@@ -1,0 +1,120 @@
+"""The mempool side of batched transaction-ingest verification.
+
+`BatchTxVerifier` is the verdict-bearing ``batch_check_hook``
+(Mempool.set_batch_check_hook(..., verdicts=True)): for each CheckTx or
+recheck window it extracts every tx's ``(pubkey, sign_bytes, sig)`` via an
+app-supplied extractor (e.g. abci/examples/kvstore.extract_signed_tx_sig),
+submits the rows to a `parallel/planner.TxFeed` keyed by the window, and
+blocks on the verdict tickets — one deadline-bounded `plan_windows`
+superdispatch per flush, riding the PR-9 breaker/deadline/audit/host-
+fallback guard, with `RLCHostVerifier` as the chipless backend.
+
+Verdicts are cached by tx hash, which is what makes the post-commit
+recheck cheap: survivors already passed admission, so their recheck window
+answers entirely from the cache and only re-runs the app's state checks —
+never a second signature verification (the mempool.py recheck-flush parity
+fix).  Cache entries are deterministic facts (a signature either verifies
+over its sign-bytes or it doesn't), so serving a hit is bit-identical to
+re-dispatching.
+
+Every degradation is graceful and bit-identical: an unsigned/odd tx, a
+closed feed, a flush error or a ticket timeout all yield a ``None``
+verdict, which the app answers with its own serial verify — the exact
+check the planner would have run.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+from typing import Callable, List, Optional
+
+from tendermint_tpu.crypto.hashing import tmhash
+
+
+class BatchTxVerifier:
+    """Verdict-bearing CheckTx-window hook: extract → feed → tickets →
+    per-tx verdicts, with a bounded tx-hash verdict cache for rechecks.
+
+    extractor: ``tx -> (pub, sign_bytes, sig) | None`` (None = the app
+    decides the whole verdict serially).
+    height_fn: ``() -> int`` supplying the mempool's current height for
+    the feed's group keys — the critpath analyzer joins each flush's cost
+    into the ``verify_dispatch`` overlay of that height.
+    """
+
+    def __init__(self, feed, extractor: Callable, *,
+                 timeout_s: float = 5.0, cache_size: int = 10000,
+                 height_fn: Optional[Callable[[], int]] = None):
+        self.feed = feed
+        self.extractor = extractor
+        self.timeout_s = float(timeout_s)
+        self.height_fn = height_fn
+        self._cache_size = max(1, int(cache_size))
+        self._cache: "collections.OrderedDict[bytes, bool]" = (
+            collections.OrderedDict()
+        )
+        self._mtx = threading.Lock()
+        self._seq = 0
+        # observability (asserted by tests, surfaced by benches)
+        self.windows = 0  # hook invocations (CheckTx + recheck flushes)
+        self.submitted = 0  # txs dispatched to the feed
+        self.cache_hits = 0  # verdicts served from the tx-hash cache
+        self.unsigned = 0  # txs the extractor declined (app decides)
+        self.feed_errors = 0  # submit/flush/timeout failures (app decides)
+
+    def __call__(self, batch_txs: List[bytes]) -> List[Optional[bool]]:
+        n = len(batch_txs)
+        verdicts: List[Optional[bool]] = [None] * n
+        with self._mtx:
+            self.windows += 1
+            self._seq += 1
+            seq = self._seq
+        height = 0
+        if self.height_fn is not None:
+            try:
+                height = int(self.height_fn())
+            except Exception:
+                height = 0
+        group_key = (height, seq)
+        tickets = []  # (batch index, tx hash, ticket)
+        for i, tx in enumerate(batch_txs):
+            h = tmhash(tx)
+            with self._mtx:
+                cached = self._cache.get(h)
+            if cached is not None:
+                verdicts[i] = cached
+                self.cache_hits += 1
+                continue
+            try:
+                item = self.extractor(tx)
+            except Exception:
+                item = None
+            if item is None:
+                self.unsigned += 1
+                continue
+            pub, msg, sig = item
+            try:
+                tickets.append((i, h, self.feed.submit(group_key, pub, msg, sig)))
+            except Exception:
+                self.feed_errors += 1
+                continue
+            self.submitted += 1
+        if tickets:
+            # the window IS a complete mempool batch — collapse the feed's
+            # deadline so admission never waits it out; the window only
+            # pays off when several callers (recheck + admission, other
+            # reactors) land inside it anyway
+            self.feed.flush_now()
+            for i, h, ticket in tickets:
+                try:
+                    ok = bool(ticket.result(timeout=self.timeout_s).ok)
+                except BaseException:
+                    self.feed_errors += 1
+                    continue
+                verdicts[i] = ok
+                with self._mtx:
+                    self._cache[h] = ok
+                    while len(self._cache) > self._cache_size:
+                        self._cache.popitem(last=False)
+        return verdicts

@@ -255,6 +255,27 @@ class Node(BaseService):
                 max_rows=config.verify.vote_batch_rows,
             )
             self.consensus_state.set_vote_feed(self.vote_feed)
+        # [mempool] tx_batch_window_ms > 0: CheckTx/recheck windows pre-verify
+        # tx signatures on a planner TxFeed dispatch when the app publishes a
+        # `tx_sig_extractor` (e.g. SignedKVStoreApp).  Same chipless backend
+        # and guard story as the vote feed above.
+        self.tx_feed = None
+        if getattr(config.mempool, "tx_batch_window_ms", 0.0) > 0:
+            extractor = getattr(
+                getattr(creator, "_app", None), "tx_sig_extractor", None
+            )
+            if extractor is not None:
+                from tendermint_tpu.mempool.tx_verify import BatchTxVerifier
+                from tendermint_tpu.parallel.planner import TxFeed
+
+                self.tx_feed = TxFeed(
+                    window_s=config.mempool.tx_batch_window_ms / 1000.0,
+                    max_rows=config.mempool.tx_batch_rows,
+                )
+                self.tx_verifier = BatchTxVerifier(
+                    self.tx_feed, extractor, height_fn=self.mempool.height
+                )
+                self.mempool.set_batch_check_hook(self.tx_verifier, verdicts=True)
         if priv_validator is not None:
             self.consensus_state.set_priv_validator(priv_validator)
         # flight recorder identity + config gate (env TM_FLIGHT may have
@@ -650,6 +671,11 @@ class Node(BaseService):
         if self.vote_feed is not None:
             try:
                 self.vote_feed.close()
+            except Exception:
+                pass
+        if self.tx_feed is not None:
+            try:
+                self.tx_feed.close()
             except Exception:
                 pass
 

@@ -1564,3 +1564,277 @@ class VoteFeed:
                         occupancy=verdict.occupancy,
                         flush_reason=reason,
                     ))
+
+
+# ---------------------------------------------------------------------------
+# Long-lived tx feed (mempool CheckTx ingest micro-batching)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class TxVerdict:
+    """One submitted transaction's signature verdict plus the shape of the
+    dispatch that served it (the tendermint_mempool_batch_* family)."""
+
+    ok: bool  # signature verified
+    batch_rows: int  # CheckTx-window rows folded into the dispatch
+    batch_lanes: int  # present lanes (txs) in the dispatch
+    occupancy: float  # lane occupancy of the dispatch
+    flush_reason: str  # deadline | quorum | close
+
+
+class TxTicket:
+    """Handle for one submitted tx; `result()` blocks until the feed's
+    worker flushes the batch the tx rode in."""
+
+    __slots__ = ("_ev", "_verdict", "_err")
+
+    def __init__(self):
+        self._ev = threading.Event()
+        self._verdict: Optional[TxVerdict] = None
+        self._err: Optional[BaseException] = None
+
+    def _resolve(self, verdict=None, err=None) -> None:
+        self._verdict = verdict
+        self._err = err
+        self._ev.set()
+
+    def result(self, timeout: Optional[float] = None) -> TxVerdict:
+        if not self._ev.wait(timeout):
+            raise TimeoutError("tx feed flush did not complete in time")
+        if self._err is not None:
+            raise self._err
+        return self._verdict
+
+
+class TxFeed:
+    """`VoteFeed`'s ingest sibling — the deadline-bounded transaction
+    micro-batcher behind the mempool's verdict-bearing `batch_check_hook`
+    (mempool/tx_verify.BatchTxVerifier).
+
+    The unit of submission is one transaction's signature check:
+    ``(pub, sign_bytes, sig)``.  Txs are keyed by the CheckTx window that
+    carried them — each ``group_key`` (a ``(height, window_seq)`` pair)
+    becomes ONE lane row of the flush, so concurrent windows (admission +
+    recheck, several reactors' flush timers) fold into the same
+    `plan_windows` superdispatch and share lane buckets (and the jit
+    compile cache) with commit-verify and vote dispatches.  The PR-9
+    breaker/deadline/audit/host-fallback guard wraps the dispatch exactly
+    as it wraps every other planner window; with no mesh the flush rides
+    `RLCHostVerifier` — one Pippenger MSM per clean batch — and
+    non-ed25519 lanes (secp256k1 senders) push the whole plan down the
+    host `verify_generic` path, bit-identically.
+
+    `flush_now()` collapses the deadline — the mempool hook calls it once
+    a whole CheckTx window has been submitted, so a full admission batch
+    never waits out the window (counted as a quorum flush, mirroring the
+    vote feed's trigger vocabulary).  Flushes record their trigger into
+    ``tendermint_mempool_batch_flush_total``."""
+
+    def __init__(self, mesh=None, verifier=None,
+                 use_device: Optional[bool] = None, window_s: float = 0.002,
+                 max_rows: int = 64,
+                 profile_kind: str = "mempool.tx_batch", on_flush=None):
+        self.mesh = mesh
+        if verifier is None:
+            # same chipless default as the vote feed: the RLC host backend
+            # batch-verifies with accept/reject bit-identical to
+            # ed25519.verify, and every guard fallback lands here
+            from tendermint_tpu.crypto.batch import RLCHostVerifier
+
+            verifier = RLCHostVerifier()
+        self.verifier = verifier
+        self.use_device = use_device
+        self.window_s = max(0.0, float(window_s))
+        self.max_rows = max(1, int(max_rows))
+        self.profile_kind = profile_kind
+        self.on_flush = on_flush  # (reason, n_txs, n_rows, verdict, s)
+        # observability: txs_in counts submissions, rows_out the
+        # CheckTx-window rows they packed into, dispatches the device
+        # round-trips, windows_out the ≤max_rows windows folded into them
+        self.dispatches = 0
+        self.windows_out = 0
+        self.txs_in = 0
+        self.rows_out = 0
+        self.flushes: dict = {"deadline": 0, "quorum": 0, "close": 0}
+        self._cond = threading.Condition()
+        # (group_key, pub, msg, sig, ticket)
+        self._pending: List[tuple] = []
+        self._deadline = 0.0
+        self._urgent = False
+        self._closed = False
+        self._thread: Optional[threading.Thread] = None
+
+    def submit(self, group_key, pub, msg: bytes, sig: bytes,
+               urgent: bool = False) -> TxTicket:
+        """Park one tx signature for the next flush; returns immediately.
+        Txs sharing `group_key` (their CheckTx window) pack into one lane
+        row.  `urgent=True` collapses the window."""
+        ticket = TxTicket()
+        with self._cond:
+            if self._closed:
+                raise RuntimeError("tx feed is closed")
+            if not self._pending:
+                self._deadline = time.monotonic() + self.window_s
+            self._pending.append(
+                (group_key, pub, bytes(msg), bytes(sig), ticket)
+            )
+            self.txs_in += 1
+            if urgent:
+                self._urgent = True
+            if self._thread is None:
+                self._thread = threading.Thread(
+                    target=self._worker, name="planner-tx-feed", daemon=True
+                )
+                self._thread.start()
+            self._cond.notify_all()
+        return ticket
+
+    def flush_now(self) -> None:
+        """Collapse the current deadline: pending txs dispatch at once
+        (counted as a quorum flush — the batch-complete trigger)."""
+        with self._cond:
+            self._urgent = True
+            self._cond.notify_all()
+
+    def close(self) -> None:
+        """Stop accepting txs; pending txs still flush before the worker
+        exits (their tickets resolve, never hang)."""
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+
+    def join(self, timeout: Optional[float] = None) -> None:
+        """Wait for the worker to drain after close() — test hygiene."""
+        t = self._thread
+        if t is not None:
+            t.join(timeout)
+
+    def _worker(self) -> None:
+        while True:
+            with self._cond:
+                while not self._pending:
+                    if self._closed:
+                        return
+                    self._cond.wait(0.1)
+                # hold the batch open for the remainder of the window
+                # unless a batch-complete flush, close, or a full
+                # superdispatch's worth of txs arrived first
+                cap = self.max_rows * windows_per_dispatch(self.mesh)
+                while (
+                    len(self._pending) < cap
+                    and not self._closed
+                    and not self._urgent
+                ):
+                    left = self._deadline - time.monotonic()
+                    if left <= 0:
+                        break
+                    self._cond.wait(left)
+                if self._closed:
+                    reason = "close"
+                elif self._urgent:
+                    reason = "quorum"
+                else:
+                    reason = "deadline"
+                self._urgent = False
+                batch, self._pending = self._pending, []
+            self._flush(batch, reason)
+
+    def _flush(self, batch: List[tuple], reason: str) -> None:
+        # one lane row per CheckTx-window group, in first-seen order; txs
+        # keep their lane position so verdicts map back per ticket
+        rows: List[tuple] = []  # (vrow, tickets)
+        by_key: dict = {}
+        for group_key, pub, msg, sig, ticket in batch:
+            row = by_key.get(group_key)
+            if row is None:
+                row = ([], [])
+                by_key[group_key] = row
+                rows.append(row)
+            row[0].append((pub, msg, sig))
+            row[1].append(ticket)
+        chunks = [
+            rows[i: i + self.max_rows]
+            for i in range(0, len(rows), self.max_rows)
+        ]
+        # quorum math is vestigial here (power 1 per lane, total = lane
+        # count): only the per-lane ok grid feeds verdicts back
+        specs = [
+            ([r[0] for r in chunk],
+             [[1] * len(r[0]) for r in chunk],
+             [len(r[0]) for r in chunk])
+            for chunk in chunks
+        ]
+        t0 = time.perf_counter()
+        try:
+            plan, verdict = _plan_and_execute_windows(
+                specs, mesh=self.mesh, verifier=self.verifier,
+                use_device=self.use_device,
+            )
+            parts = split_verdict(plan, verdict)
+        except BaseException as e:
+            for row in rows:
+                for ticket in row[1]:
+                    ticket._resolve(err=e)
+            return
+        seconds = time.perf_counter() - t0
+        self.dispatches += 1
+        self.windows_out += len(chunks)
+        self.rows_out += len(rows)
+        self.flushes[reason] = self.flushes.get(reason, 0) + 1
+        try:
+            # group keys lead with the mempool height ((height, window_seq)
+            # — tx_verify.BatchTxVerifier); annotate the ledger entry with
+            # the batch's base height so the critpath analyzer joins
+            # ingest-verify cost into the verify_dispatch overlay of the
+            # height it served
+            hs = sorted({
+                gk[0] for gk in by_key
+                if isinstance(gk, tuple) and gk and isinstance(gk[0], int)
+            })
+            prof = get_profiler()
+            if hs:
+                with prof.window(hs[0], heights=hs[-1] - hs[0] + 1):
+                    prof.record(
+                        self.profile_kind,
+                        lanes_present=verdict.lanes_present,
+                        lanes_dispatched=verdict.lanes_dispatched,
+                        run_seconds=seconds,
+                        n_windows=len(chunks),
+                    )
+            else:
+                prof.record(
+                    self.profile_kind,
+                    lanes_present=verdict.lanes_present,
+                    lanes_dispatched=verdict.lanes_dispatched,
+                    heights=len(rows),
+                    run_seconds=seconds,
+                    n_windows=len(chunks),
+                )
+        except Exception:
+            pass
+        try:
+            from tendermint_tpu.libs.metrics import get_mempool_batch_metrics
+
+            get_mempool_batch_metrics().record_flush(
+                reason, rows=len(rows), lanes=verdict.lanes_present,
+                occupancy=verdict.occupancy,
+            )
+        except Exception:
+            pass
+        if self.on_flush is not None:
+            try:
+                self.on_flush(reason, len(batch), len(rows), verdict, seconds)
+            except Exception:
+                pass
+        for ci, chunk in enumerate(chunks):
+            part = parts[ci]
+            for ri, (vrow, tickets) in enumerate(chunk):
+                for j, ticket in enumerate(tickets):
+                    ticket._resolve(TxVerdict(
+                        ok=bool(part.ok[ri, j]),
+                        batch_rows=len(rows),
+                        batch_lanes=verdict.lanes_present,
+                        occupancy=verdict.occupancy,
+                        flush_reason=reason,
+                    ))

@@ -54,8 +54,14 @@ class AppConnMempool:
     def error(self) -> Optional[Exception]:
         return self._c.error()
 
-    def check_tx_async(self, tx: bytes) -> ReqRes:
-        return self._c.request_async(abci.RequestCheckTx(tx=tx))
+    def check_tx_async(
+        self, tx: bytes, sig_verified: Optional[bool] = None
+    ) -> ReqRes:
+        # sig_verified: batched-ingest verdict hint (mempool/tx_verify.py);
+        # None keeps the reference contract (the app verifies serially)
+        return self._c.request_async(
+            abci.RequestCheckTx(tx=tx, sig_verified=sig_verified)
+        )
 
     def flush_async(self) -> None:
         if hasattr(self._c, "request_async"):

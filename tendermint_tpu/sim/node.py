@@ -142,6 +142,24 @@ class SimNode:
                 max_rows=cfg.verify.vote_batch_rows,
             )
             self.cs.set_vote_feed(self.vote_feed)
+        # [mempool] tx_batch_window_ms > 0: batched CheckTx signature
+        # ingest when the app publishes a tx_sig_extractor (same wiring as
+        # node/node.py; exposed so scenarios can assert dispatch counts)
+        self.tx_feed = None
+        self.tx_verifier = None
+        _extractor = getattr(self.app, "tx_sig_extractor", None)
+        if getattr(cfg.mempool, "tx_batch_window_ms", 0.0) > 0 and _extractor:
+            from tendermint_tpu.mempool.tx_verify import BatchTxVerifier
+            from tendermint_tpu.parallel.planner import TxFeed
+
+            self.tx_feed = TxFeed(
+                window_s=cfg.mempool.tx_batch_window_ms / 1000.0,
+                max_rows=cfg.mempool.tx_batch_rows,
+            )
+            self.tx_verifier = BatchTxVerifier(
+                self.tx_feed, _extractor, height_fn=self.mempool.height
+            )
+            self.mempool.set_batch_check_hook(self.tx_verifier, verdicts=True)
         self.cs.set_event_bus(self.bus)
         self.cs.set_priv_validator(pv)
         self.cs.now_ns = self.clock
@@ -187,6 +205,11 @@ class SimNode:
         if self.vote_feed is not None:
             try:
                 self.vote_feed.close()
+            except Exception:
+                pass
+        if self.tx_feed is not None:
+            try:
+                self.tx_feed.close()
             except Exception:
                 pass
 

@@ -26,9 +26,15 @@ equivocation          Byzantine double-signer ⇒ DuplicateVoteEvidence is
                       and marked committed in every pool
 silence_watchdog      >1/3 power silenced ⇒ watchdog stall report names
                       the silenced validators' cumulative power; heals
-mempool_flood         one node spams ~10x the per-peer QoS rate ⇒ honest
-                      priority txs still commit, mempools stay bounded,
-                      drops land in tendermint_mempool_qos_* counters
+mempool_flood         one node spams signed txs at ~10x the per-peer QoS
+                      rate with batched TxFeed ingest on ⇒ honest priority
+                      txs still commit, mempools stay bounded, drops land
+                      in tendermint_mempool_qos_* counters
+signed_flood          mixed valid/garbage/wrong-nonce/mutant signed txs
+                      through the batched ingest path while the device
+                      backend flaps ⇒ admit/reject codes bit-identical to
+                      a serial-verify oracle mempool, committed app state
+                      identical on every node, feed demonstrably engaged
 device_flap           FaultyDevice behind the guarded verifier fails, hangs,
                       then silently corrupts ⇒ breaker walks closed→open→
                       half_open→closed, then quarantines on the audit
@@ -485,12 +491,19 @@ def silence_watchdog() -> Scenario:
 
 
 def mempool_flood() -> Scenario:
-    """One node floods spam txs at ~10x the per-peer QoS budget while
-    consensus runs.  Honest high-priority txs must still commit, every
+    """One node floods signed spam txs well above the per-peer QoS budget
+    while consensus runs, with the batched TxFeed ingest path on (the
+    signed workload makes admission expensive enough that the QoS verdicts
+    matter).  Honest high-priority signed txs must still commit, every
     node's mempool stays bounded at `size`, the spammer's bucket saturates
     (per-peer drop counts on honest nodes), and the drops are visible in
-    the tendermint_mempool_qos_* metric exposition."""
-    from tendermint_tpu.abci.examples.kvstore import PriorityKVStoreApp
+    the tendermint_mempool_qos_* metric exposition — the PR-8 fairness
+    story must survive batched ingest unchanged."""
+    from tendermint_tpu.abci.examples.kvstore import (
+        SignedKVStoreApp,
+        make_signed_tx,
+    )
+    from tendermint_tpu.crypto.keys import PrivKeyEd25519
     from tendermint_tpu.mempool.mempool import MempoolError
 
     MAX_TXS = 100
@@ -499,26 +512,52 @@ def mempool_flood() -> Scenario:
     def config():
         cfg = test_config()
         cfg.mempool.size = MAX_TXS
-        cfg.mempool.qos_peer_tx_rate = 50.0
-        cfg.mempool.qos_peer_tx_burst = 25.0
+        # the signed workload paces the spammer's own gossip: its walker
+        # forwards txs only as fast as its batched admission admits them,
+        # so the budget must sit below that delivery rate (~30-100 tx/s
+        # in-sim) for the honest buckets to saturate
+        cfg.mempool.qos_peer_tx_rate = 10.0
+        cfg.mempool.qos_peer_tx_burst = 10.0
         # keep peers unmuted so the scenario measures steady-state rate
         # limiting, not the (separately unit-tested) mute escalation
         cfg.mempool.qos_mute_after = 0
+        # batched signature ingest: admission windows pre-verify on the
+        # planner feed instead of one serial verify per tx in the app
+        cfg.mempool.checktx_batch = 16
+        cfg.mempool.tx_batch_window_ms = 2.0
+        cfg.mempool.tx_batch_rows = 64
         return cfg
 
-    honest_txs = [b"pri2000:hon%d=x" % i for i in range(5)]
-    honest_keys = [tx.split(b"=", 1)[0] for tx in honest_txs]
+    def _key(i: int) -> PrivKeyEd25519:
+        return PrivKeyEd25519.generate(b"flood-key-%04d" % i + b"\x00" * 18)
+
+    # 8 spam senders x SPAM/8 sequential nonces; honest txs are one
+    # high-priority payload per distinct sender
+    spam_privs = [_key(i) for i in range(8)]
+    honest_payloads = [b"pri2000:hon%d=x" % i for i in range(5)]
+    honest_txs = [
+        make_signed_tx(_key(100 + i), 1, p)
+        for i, p in enumerate(honest_payloads)
+    ]
+    honest_keys = [p.split(b"=", 1)[0] for p in honest_payloads]
 
     def drive(run: ScenarioRun) -> List[str]:
         failures = []
         if not run.wait_height(1, 30.0):
             return [f"never warmed up: {run.heights()}"]
         spammer = run.nodes[3]
+        # sign outside the submission loop: the flood's arrival RATE at the
+        # honest peers is what saturates their buckets, so the loop must
+        # stay tight
+        spam_txs = [
+            make_signed_tx(spam_privs[i % 8], i // 8 + 1, b"spam%06d=x" % i)
+            for i in range(SPAM)
+        ]
         # local submissions bypass QoS (it guards the peer boundary); the
         # flood reaches honest nodes via gossip, where their buckets bite
-        for i in range(SPAM):
+        for tx in spam_txs:
             try:
-                spammer.mempool.check_tx(b"spam%06d=x" % i)
+                spammer.mempool.check_tx(tx)
             except MempoolError:
                 pass
         for tx in honest_txs:
@@ -575,18 +614,192 @@ def mempool_flood() -> Scenario:
                     f"{node.node_id}: qos drop counter missing from "
                     f"metric exposition"
                 )
+        # the flood must actually have ridden the batched ingest path
+        if not any(
+            n.tx_feed is not None and n.tx_feed.dispatches > 0
+            for n in run.nodes
+        ):
+            failures.append("tx feed never dispatched under flood")
         return failures
 
     return Scenario(
         name="mempool_flood",
-        description="one node spams txs at ~10x the per-peer QoS rate; "
-                    "honest priority txs still commit, mempools stay "
-                    "bounded, and the spammer's drops land in the "
+        description="one node spams signed txs at ~10x the per-peer QoS "
+                    "rate through the batched TxFeed ingest path; honest "
+                    "priority txs still commit, mempools stay bounded, and "
+                    "the spammer's drops land in the "
                     "tendermint_mempool_qos_* counters",
         seed=8,
         timeout_s=120.0,
         config_factory=config,
-        app_factory=lambda i: PriorityKVStoreApp(),
+        app_factory=lambda i: SignedKVStoreApp(),
+        drive=drive,
+        check=check,
+    )
+
+
+def signed_flood() -> Scenario:
+    """A mixed stream of valid / garbage-sig / wrong-nonce / mutant /
+    undecodable signed txs rides the batched TxFeed ingest path while the
+    device backend behind the guarded verifier flaps mid-stream.  Claims:
+    every admit/reject code is bit-identical to a serial-verify oracle
+    mempool fed the same stream, the committed app state ends identical on
+    every node, the feed demonstrably dispatched (and fell back host-side
+    through the flap), and the whole episode lands in the
+    tendermint_mempool_batch_* exposition."""
+    from tendermint_tpu.abci.examples.kvstore import SignedKVStoreApp
+
+    def config():
+        cfg = test_config()
+        cfg.mempool.checktx_batch = 16
+        cfg.mempool.tx_batch_window_ms = 2.0
+        cfg.mempool.tx_batch_rows = 64
+        return cfg
+
+    def setup(run: ScenarioRun) -> None:
+        from tendermint_tpu.crypto import batch as _batch
+        from tendermint_tpu.libs import breaker as _brk
+        from tendermint_tpu.sim.faults import FaultyDevice
+
+        br = _brk.configure_device_guard(
+            breaker_threshold=3, breaker_backoff=0.2,
+            breaker_backoff_max=0.4, dispatch_deadline=0.3,
+            audit_sample_rate=1.0, retries=0,
+        )
+        prev = _batch.get_batch_verifier()
+        dev = FaultyDevice(_batch.HostBatchVerifier(),
+                           seed=run.scenario.seed, hang_s=1.0)
+        _batch.set_batch_verifier(_batch.GuardedBatchVerifier(dev, breaker=br))
+        run.device, run.breaker = dev, br
+        run.defer(_brk.reset_device_guard)
+        run.defer(lambda: _batch.set_batch_verifier(prev))
+
+    def _stream():
+        from tendermint_tpu.abci.examples.kvstore import make_signed_tx
+        from tendermint_tpu.crypto.keys import PrivKeyEd25519, PrivKeySecp256k1
+
+        privs = [
+            PrivKeyEd25519.generate(b"signed-flood-%03d" % i + b"\x00" * 16)
+            for i in range(6)
+        ]
+        secp = PrivKeySecp256k1.generate(b"signed-flood-secp" + b"\x00" * 15)
+        txs = []
+        for i, p in enumerate(privs):
+            txs.append(make_signed_tx(p, 1, b"sf%02d=a" % i))
+            garbage = bytearray(make_signed_tx(p, 2, b"sg%02d=b" % i))
+            garbage[-6] ^= 0x55  # flips a payload byte -> sig mismatch
+            txs.append(bytes(garbage))
+            txs.append(make_signed_tx(p, 9, b"sw%02d=c" % i))  # nonce gap
+            txs.append(make_signed_tx(p, 2, b"sk%02d=d" % i))
+        txs.append(make_signed_tx(secp, 1, b"sfsecp=e"))  # host-lane algo
+        txs.append(b"\x00garbage-not-a-signed-tx")  # undecodable
+        valid_keys = (
+            [b"sf%02d" % i for i in range(6)]
+            + [b"sk%02d" % i for i in range(6)]
+            + [b"sfsecp"]
+        )
+        return txs, valid_keys
+
+    def drive(run: ScenarioRun) -> List[str]:
+        import time as _time
+
+        from tendermint_tpu.mempool.mempool import Mempool, MempoolError
+        from tendermint_tpu.proxy.app_conn import (
+            LocalClientCreator,
+            MultiAppConn,
+        )
+
+        failures = []
+        if not run.wait_height(1, 30.0):
+            return [f"never warmed up: {run.heights()}"]
+        txs, valid_keys = _stream()
+
+        # serial oracle: same app, same stream, no feed — the app verifies
+        # every signature inline; its codes are the ground truth
+        oracle_conn = MultiAppConn(LocalClientCreator(SignedKVStoreApp()))
+        oracle_conn.start()
+        run.defer(oracle_conn.stop)
+        oracle_mp = Mempool(oracle_conn.mempool, checktx_batch=1)
+        oracle_codes = []
+        for tx in txs:
+            try:
+                oracle_mp.check_tx(
+                    tx, lambda res, _c=oracle_codes: _c.append(res.code))
+            except MempoolError:
+                oracle_codes.append(-1)
+
+        node = run.nodes[0]
+        codes = [None] * len(txs)
+        dev = run.device
+        for i, tx in enumerate(txs):
+            if i == len(txs) // 3:
+                dev.fail_rate = 1.0  # device flap mid-flood -> host fallback
+            if i == 2 * len(txs) // 3:
+                dev.fail_rate = 0.0
+            try:
+                node.mempool.check_tx(
+                    tx,
+                    lambda res, _i=i: codes.__setitem__(_i, res.code),
+                )
+            except MempoolError:
+                codes[i] = -1
+            _time.sleep(0.002)  # let windows close across the flap phases
+        if not run.wait_for(lambda: all(c is not None for c in codes), 30.0):
+            return [f"CheckTx verdicts never settled: {codes}"]
+        if codes != oracle_codes:
+            failures.append(
+                "batched admit/reject codes diverged from the serial "
+                f"oracle: {oracle_codes} vs {codes}"
+            )
+        # every valid tx must commit everywhere, and the committed state
+        # must be bit-identical across nodes (DeliverTx re-verifies
+        # serially, so state equality IS serial-path equality)
+        if not run.wait_for(
+            lambda: all(
+                all(k in n.app.state for k in valid_keys)
+                for n in run.nodes
+            ),
+            timeout=60.0,
+        ):
+            missing = {
+                n.node_id: [k.decode() for k in valid_keys
+                            if k not in n.app.state]
+                for n in run.nodes
+            }
+            failures.append(f"valid signed txs not committed: {missing}")
+        states = {tuple(sorted(n.app.state.items())) for n in run.nodes}
+        if len(states) != 1:
+            failures.append("committed app state diverged across nodes")
+        nonces = {tuple(sorted(n.app.nonces.items())) for n in run.nodes}
+        if len(nonces) != 1:
+            failures.append("committed nonce maps diverged across nodes")
+        return failures
+
+    def check(run: ScenarioRun) -> List[str]:
+        failures = []
+        node = run.nodes[0]
+        if node.tx_feed is None or node.tx_feed.dispatches == 0:
+            failures.append("tx feed never dispatched")
+        if run.device.snapshot()["failures"] == 0:
+            failures.append("device flap never fired")
+        text = node.metrics.registry.expose_text()
+        for name in ("tendermint_mempool_batch_rows",
+                     "tendermint_mempool_batch_flush_total"):
+            if name not in text:
+                failures.append(f"{name} missing from metric exposition")
+        return failures
+
+    return Scenario(
+        name="signed_flood",
+        description="mixed valid/garbage/wrong-nonce/mutant signed txs "
+                    "through batched TxFeed ingest while the device "
+                    "backend flaps; codes bit-identical to a serial "
+                    "oracle, committed state identical on every node",
+        seed=11,
+        timeout_s=180.0,
+        config_factory=config,
+        app_factory=lambda i: SignedKVStoreApp(),
+        setup=setup,
         drive=drive,
         check=check,
     )
@@ -778,6 +991,7 @@ SCENARIOS: Dict[str, Callable[[], Scenario]] = {
     "vote_storm": vote_storm,
     "silence_watchdog": silence_watchdog,
     "mempool_flood": mempool_flood,
+    "signed_flood": signed_flood,
     "device_flap": device_flap,
     "crash_restart": crash_restart,
 }
