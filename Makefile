@@ -40,15 +40,19 @@ planner-bench:
 # slope (lower-is-better — the carry-schedule regression gate) against the
 # previous round.  Uses the Pallas kernel when the TPU tunnel is up, else
 # the XLA kernel on the local backend — end-to-end runnable on
-# JAX_PLATFORMS=cpu.
+# JAX_PLATFORMS=cpu.  The run also measures the one-MSM-per-window RLC
+# path against the ladder at n=512 (ops/ed25519_msm) and gates its
+# throughput, ed25519_msm_sigs_per_s, the same way.
 FE_BACKEND ?= vpu
 pallas-bench:
 	$(PYTHON) scripts/profile_pallas.py \
-	  --fe-backend $(FE_BACKEND) --round-dir build/pallas_bench \
+	  --fe-backend $(FE_BACKEND) --ed25519-path msm \
+	  --round-dir build/pallas_bench \
 	  --metrics-out build/pallas_bench/verify_metrics.prom $(ARGS)
 	$(PYTHON) scripts/bench_check.py --dir build/pallas_bench \
 	  --metric "ed25519_sigs_per_s$(if $(filter-out vpu,$(FE_BACKEND)),_$(FE_BACKEND)):0.25:higher" \
-	  --metric "pallas_ladder_window_slope$(if $(filter-out vpu,$(FE_BACKEND)),_$(FE_BACKEND)):0.25:lower"
+	  --metric "pallas_ladder_window_slope$(if $(filter-out vpu,$(FE_BACKEND)),_$(FE_BACKEND)):0.25:lower" \
+	  --metric "ed25519_msm_sigs_per_s$(if $(filter-out vpu,$(FE_BACKEND)),_$(FE_BACKEND)):0.25:higher"
 
 bench_secp:
 	$(PYTHON) scripts/bench_secp.py 1024

@@ -42,6 +42,10 @@ DEFAULT_METRICS = [
     "mempool_checktx_per_s:0.25:higher",
     # batched-verify headline (scripts/profile_pallas.py / make pallas-bench)
     "ed25519_sigs_per_s:0.25:higher",
+    # one-MSM-per-window RLC throughput at n=512 on the XLA kernels
+    # (scripts/profile_pallas.py --ed25519-path msm; PERF.md cost model
+    # floor: >= 2x the ladder at the same shape)
+    "ed25519_msm_sigs_per_s:0.25:higher",
     # per-window ladder cost (ms/window) — the carry-schedule regression
     # gate: the windowed point ops are where the deferred-carry pool
     # lives, so a lazy-carry regression moves this slope first

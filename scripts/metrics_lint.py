@@ -299,6 +299,9 @@ def _self_check():
                        carry_mode="lazy")
     vm.record_dispatch("pallas", "ed25519", 256, 0.1, fe_backend="vpu",
                        carry_mode="eager")
+    # verify-strategy attribution ([verify] ed25519_path: ladder | msm)
+    vm.record_dispatch("planner_msm", "ed25519", 512, 0.05, fe_backend="vpu",
+                       carry_mode="lazy", ed25519_path="msm")
     vm.host_fallback.add(1.0, ("no_tpu",))
     vm.speculative.add(3.0, ("hit",))
     vm.window_heights.observe(512.0)

@@ -20,6 +20,14 @@ Emits JSON lines (captured into BENCH_LOCAL.md by scripts/bench_ledger.py):
 fe_backend); with a non-default backend every metric name is suffixed
 ``_<backend>`` so BENCH_LOCAL.md keeps one row per backend.
 
+`--ed25519-path msm` ADDITIONALLY measures the one-MSM-per-window RLC
+path (ops/ed25519_msm) against the per-row ladder at n=512 on the XLA
+kernels:
+  xla_ladder_512 / xla_msm_512      — wall ms per batch (median of 3)
+  ed25519_ladder512_sigs_per_s      — ladder throughput at the MSM shape
+  ed25519_msm_sigs_per_s            — MSM throughput (gated by bench_check)
+  ed25519_msm_speedup               — msm/ladder ratio (PERF.md floor: 2x)
+
 Without a TPU the Pallas stage split is unmeasurable (interpret mode is
 minutes per call), so the script degrades to the XLA kernel on the local
 backend — slower, but it keeps ``make pallas-bench`` producing a real
@@ -194,6 +202,42 @@ def _profile_xla_fallback(emit, fe_backend):
     return N_CPU, e2e_ms, "xla"
 
 
+N_MSM = 512
+
+
+def _profile_msm(emit, fe_backend):
+    """MSM-vs-ladder comparison at N_MSM rows on the XLA kernels.
+
+    Both paths run on whatever platform jax resolved (the committed
+    rounds use JAX_PLATFORMS=cpu) with the SAME corpus, so the ratio is
+    the Pippenger amortization alone.  The RLC seed is pinned to the
+    deterministic corpus seed (rlc_seed) — the digit schedule, and with
+    it the jit cache key, is identical across reps."""
+    from tendermint_tpu.ops import ed25519_verify as xk
+
+    pubs, msgs, sigs = _make_corpus(N_MSM)
+    ok = xk.verify_batch(pubs, msgs, sigs, fe_backend=fe_backend)  # compile
+    assert ok.all()
+    lad_ms = _median_ms(
+        lambda: xk.verify_batch(pubs, msgs, sigs, fe_backend=fe_backend),
+        reps=3,
+    )
+    emit(f"xla_ladder_{N_MSM}", lad_ms)
+    seed = xk.rlc_seed(pubs, sigs)
+    ok = xk.rlc_verify_batch(
+        pubs, msgs, sigs, fe_backend=fe_backend, seed=seed
+    )  # compile
+    assert ok.all()
+    msm_ms = _median_ms(
+        lambda: xk.rlc_verify_batch(
+            pubs, msgs, sigs, fe_backend=fe_backend, seed=seed
+        ),
+        reps=3,
+    )
+    emit(f"xla_msm_{N_MSM}", msm_ms)
+    return lad_ms, msm_ms
+
+
 def _write_round(round_dir, parsed, rc):
     os.makedirs(round_dir, exist_ok=True)
     nums = [
@@ -227,6 +271,10 @@ def main(argv=None):
     p.add_argument("--fe-backend", default="vpu",
                    choices=("vpu", "mxu", "mxu16"),
                    help="limb-multiplier backend ([verify] fe_backend)")
+    p.add_argument("--ed25519-path", default="ladder",
+                   choices=("ladder", "msm"),
+                   help="msm: also bench the one-MSM-per-window RLC path "
+                        "vs the ladder at n=512 ([verify] ed25519_path)")
     p.add_argument("--round-dir", default="",
                    help="append a BENCH_rNN.json round under DIR "
                         "(for scripts/bench_check.py --dir DIR)")
@@ -261,6 +309,20 @@ def main(argv=None):
         "ed25519_sigs_per_s" + suffix: sigs_per_s,
     }), flush=True)
 
+    if args.ed25519_path == "msm":
+        lad_ms, msm_ms = _profile_msm(emit, be)
+        lad_sps = round(N_MSM / (lad_ms / 1e3), 1)
+        msm_sps = round(N_MSM / (msm_ms / 1e3), 1)
+        speedup = round(lad_ms / msm_ms, 2) if msm_ms else 0.0
+        for name, value, unit in (
+            (f"ed25519_ladder{N_MSM}_sigs_per_s" + suffix, lad_sps, "sigs/s"),
+            ("ed25519_msm_sigs_per_s" + suffix, msm_sps, "sigs/s"),
+            ("ed25519_msm_speedup" + suffix, speedup, "x"),
+        ):
+            _emitted[name] = value
+            print(json.dumps({"metric": name, "value": value, "unit": unit,
+                              "fe_backend": be, name: value}), flush=True)
+
     try:
         from tendermint_tpu.libs.metrics import get_verify_metrics
 
@@ -269,7 +331,14 @@ def main(argv=None):
             # the kernels default to the lazy schedule; mxu16 has no lazy
             # plan and degrades (fe_common.effective_carry_mode)
             carry_mode="eager" if be == "mxu16" else "lazy",
+            ed25519_path="ladder",
         )
+        if args.ed25519_path == "msm":
+            get_verify_metrics().record_dispatch(
+                "xla", "ed25519", N_MSM, msm_ms / 1e3, fe_backend=be,
+                carry_mode="eager" if be == "mxu16" else "lazy",
+                ed25519_path="msm",
+            )
     except Exception:
         pass
     if metrics_out and os.path.dirname(metrics_out):

@@ -236,6 +236,13 @@ class VerifyConfig:
     # audit/breaker machinery cross-checks them like any device backend.
     # TM_FE_BACKEND env overrides.
     fe_backend: str = "vpu"
+    # device verify strategy: "ladder" (per-signature double-scalar
+    # ladder, one lane per row) or "msm" (random-linear-combination
+    # check — ONE Pippenger multi-scalar multiplication verifies the
+    # whole window; rejected windows localize via chunk RLCs and exact
+    # ladder re-runs, so accept/reject stays bit-identical).
+    # TM_ED25519_PATH env overrides.
+    ed25519_path: str = "ladder"
     # WindowPipeline depth: packed windows allowed in flight ahead of the
     # device (host SHA-512/decompress/pack for windows N+1..N+k overlaps
     # window N's dispatch).  2 = the classic double buffer; deeper keeps

@@ -35,15 +35,16 @@ from tendermint_tpu.libs.metrics import get_verify_metrics
 
 def _record_dispatch(backend: str, algo: str, n: int, t0: float, ok,
                      first: bool = False, fe_backend: str = "",
-                     carry_mode: str = "") -> None:
+                     carry_mode: str = "", ed25519_path: str = "") -> None:
     """One VerifyMetrics record per batch dispatch (size, latency, rejects,
-    and which limb-multiplier backend / carry schedule served the window).
-    Telemetry must never take down the verify path."""
+    and which limb-multiplier backend / carry schedule / verify strategy
+    served the window).  Telemetry must never take down the verify path."""
     try:
         get_verify_metrics().record_dispatch(
             backend, algo, n, time.perf_counter() - t0,
             rejects=n - int(np.count_nonzero(ok)), first=first,
             fe_backend=fe_backend, carry_mode=carry_mode,
+            ed25519_path=ed25519_path,
         )
     except Exception:
         pass
@@ -73,6 +74,34 @@ def _resolve_fe_backend(explicit: Optional[str]) -> str:
     if v not in _FE_BACKENDS:
         raise ValueError(
             f"fe_backend must be one of {_FE_BACKENDS}, got {v!r}"
+        )
+    return v
+
+
+# device verify strategies (ops/ed25519_verify.verify_batch vs the
+# one-MSM-per-window RLC path, ops/ed25519_msm)
+_ED25519_PATHS = ("ladder", "msm")
+_default_ed25519_path: Optional[str] = None
+
+
+def set_default_ed25519_path(value: Optional[str]) -> None:
+    """Install the process-wide [verify] ed25519_path choice (node
+    composition root).  TM_ED25519_PATH still overrides per-process."""
+    global _default_ed25519_path
+    _default_ed25519_path = value or None
+
+
+def _resolve_ed25519_path(explicit: Optional[str]) -> str:
+    import os
+
+    v = explicit or os.environ.get("TM_ED25519_PATH", "") or \
+        _default_ed25519_path or "ladder"
+    v = v.strip().lower()
+    if v in ("", "auto"):
+        return "ladder"
+    if v not in _ED25519_PATHS:
+        raise ValueError(
+            f"ed25519_path must be one of {_ED25519_PATHS}, got {v!r}"
         )
     return v
 
@@ -195,13 +224,23 @@ class TPUBatchVerifier:
     "mxu16"; ops/fe_common).  None = TM_FE_BACKEND env, then the [verify]
     fe_backend config (set_default_fe_backend), then "vpu".  All backends
     are bit-exact — the PR 9 audit/breaker guard treats them identically.
+
+    ed25519_path: "ladder" verifies one signature per lane with the
+    double-scalar ladder kernel; "msm" folds the whole window into ONE
+    Pippenger multi-scalar multiplication via a random linear combination
+    (ops/ed25519_msm) and falls back to chunk RLCs + exact ladder rows on
+    a window reject, so accept/reject stays bit-identical.  None =
+    TM_ED25519_PATH env, then the [verify] ed25519_path config
+    (set_default_ed25519_path), then "ladder".
     """
 
     name = "tpu"
 
     def __init__(self, mesh=None, backend: Optional[str] = None,
-                 fe_backend: Optional[str] = None):
+                 fe_backend: Optional[str] = None,
+                 ed25519_path: Optional[str] = None):
         self.fe_backend = _resolve_fe_backend(fe_backend)
+        self.ed25519_path = _resolve_ed25519_path(ed25519_path)
         # carry schedule the kernels will trace with — the kernels default
         # to lazy and degrade mxu16 to eager themselves
         # (fe_common.effective_carry_mode); mirrored here, without the jax
@@ -263,9 +302,21 @@ class TPUBatchVerifier:
                 import jax
 
                 dev = None if jax.default_backend() == "tpu" else self._tpu
-                ok = self._kernel.verify_batch(
-                    pubs_a, msgs, sigs_a, device=dev,
-                    fe_backend=self.fe_backend,
+                if self.ed25519_path == "msm":
+                    ok = self._kernel.rlc_verify_batch(
+                        pubs_a, msgs, sigs_a, device=dev,
+                        fe_backend=self.fe_backend,
+                    )
+                else:
+                    ok = self._kernel.verify_batch(
+                        pubs_a, msgs, sigs_a, device=dev,
+                        fe_backend=self.fe_backend,
+                    )
+            elif self.ed25519_path == "msm":
+                # the MSM folds the window into one point equation — there
+                # is no lane axis to shard, so the mesh is not consulted
+                ok = self._kernel.rlc_verify_batch(
+                    pubs_a, msgs, sigs_a, fe_backend=self.fe_backend,
                 )
             else:
                 ok = self._kernel.verify_batch(
@@ -276,7 +327,8 @@ class TPUBatchVerifier:
         self._warm.add("ed25519")
         _record_dispatch(self.backend, "ed25519", len(pubs), t0, ok,
                          first=first, fe_backend=self.fe_backend,
-                         carry_mode=self.carry_mode)
+                         carry_mode=self.carry_mode,
+                         ed25519_path=self.ed25519_path)
         return ok
 
     def verify_secp256k1(self, items: Sequence[SigItem]) -> np.ndarray:

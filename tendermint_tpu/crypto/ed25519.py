@@ -359,19 +359,16 @@ _A_NEG_CACHE: dict = {}
 _A_NEG_CACHE_MAX = 16384
 
 
-def verify_batch(items) -> list:
-    """Batch verification of [(public_key, message, sig), ...] with the
-    same accept/reject semantics as ``verify`` on every element.
-
-    One random-linear-combination + Pippenger multi-scalar multiplication
-    costs ~10x fewer point operations per signature than independent
-    verifies, which is the whole throughput story of the vote micro-batch
-    on hosts without an accelerator or OpenSSL.  Invalid signatures are
-    localized by recursive bisection, so per-item verdicts are exact (a
-    false accept needs a 2^-128 RLC collision).  When the `cryptography`
-    fast path is available it wins per-signature and we just ride it."""
-    if _HAVE_CRYPTOGRAPHY:
-        return [verify(p, m, s) for p, m, s in items]
+def _parse_batch(items, compute_h: bool = True) -> Tuple[list, list]:
+    """Parse [(public_key, message, sig), ...] into RLC-ready rows with the
+    Go accept/reject edges applied on the host: rows with bad lengths, a set
+    top-3-bit in s, an undecompressable A or R, or a non-canonical R
+    encoding stay False in ``out`` (exactly as ``verify`` rejects them) and
+    never reach the MSM.  Returns ``(parsed, out)`` where ``parsed`` holds
+    ``(i, neg_a, neg_r, h, s)`` extended-point rows and ``out`` is the
+    all-False verdict list the resolver scatters into.  ``compute_h=False``
+    leaves h as 0 for callers that hash on-device (the Pallas SHA-512
+    prologue) and substitute their own values."""
     out = [False] * len(items)
     parsed = []
     a_cache = _A_NEG_CACHE  # validators recur across votes, rounds AND flushes
@@ -399,12 +396,32 @@ def verify_batch(items) -> list:
         # match, whatever the curve math says
         if (R[1] | ((R[0] & 1) << 255)).to_bytes(32, "little") != sig[:32]:
             continue
-        h = int.from_bytes(
-            hashlib.sha512(sig[:32] + pub + bytes(msg)).digest(), "little"
-        ) % L
+        if compute_h:
+            h = int.from_bytes(
+                hashlib.sha512(sig[:32] + pub + bytes(msg)).digest(), "little"
+            ) % L
+        else:
+            h = 0
         s = int.from_bytes(sig[32:], "little") % L  # [s]B == [s mod L]B
         neg_r = _to_extended(((P - R[0]) % P, R[1]))
         parsed.append((i, neg_a, neg_r, h, s))
+    return parsed, out
+
+
+def verify_batch(items) -> list:
+    """Batch verification of [(public_key, message, sig), ...] with the
+    same accept/reject semantics as ``verify`` on every element.
+
+    One random-linear-combination + Pippenger multi-scalar multiplication
+    costs ~10x fewer point operations per signature than independent
+    verifies, which is the whole throughput story of the vote micro-batch
+    on hosts without an accelerator or OpenSSL.  Invalid signatures are
+    localized by recursive bisection, so per-item verdicts are exact (a
+    false accept needs a 2^-128 RLC collision).  When the `cryptography`
+    fast path is available it wins per-signature and we just ride it."""
+    if _HAVE_CRYPTOGRAPHY:
+        return [verify(p, m, s) for p, m, s in items]
+    parsed, out = _parse_batch(items)
     _resolve_batch(parsed, out)
     return out
 

@@ -384,14 +384,16 @@ class VerifyMetrics:
             label_names=("outcome",),
         )
         # limb-multiplier attribution: which fe backend (ops/fe_common)
-        # served each device window — vpu | mxu | mxu16 — and which carry
-        # schedule it traced with (eager | lazy); host dispatches carry
-        # no fe backend and are not recorded here
+        # served each device window — vpu | mxu | mxu16 — which carry
+        # schedule it traced with (eager | lazy), and which verify
+        # strategy decided the window (ladder | msm; ops/ed25519_msm);
+        # host dispatches carry no fe backend and are not recorded here
         self.fe_dispatch = r.counter(
             "verify_fe_backend_total",
-            "Batch-verify device dispatches by limb-multiplier backend "
-            "and carry schedule",
-            label_names=("backend", "fe_backend", "carry_mode"),
+            "Batch-verify device dispatches by limb-multiplier backend, "
+            "carry schedule and ed25519 verify path",
+            label_names=("backend", "fe_backend", "carry_mode",
+                         "ed25519_path"),
         )
         # per-device attribution of mesh superdispatches: which devices the
         # lane tile sharded across and how many lanes each shard carried.
@@ -433,7 +435,8 @@ class VerifyMetrics:
     def record_dispatch(self, backend: str, algo: str, n: int,
                         seconds: float, rejects: int = 0,
                         first: bool = False, fe_backend: str = "",
-                        carry_mode: str = "") -> None:
+                        carry_mode: str = "",
+                        ed25519_path: str = "") -> None:
         """One batch dispatch: size + latency + outcome in one call so the
         instrumented hot paths stay one-liners."""
         self.batch_size.observe(float(n))
@@ -445,7 +448,10 @@ class VerifyMetrics:
         if rejects:
             self.rejects.add(float(rejects), (backend, algo))
         if fe_backend:
-            self.fe_dispatch.add(1.0, (backend, fe_backend, carry_mode))
+            self.fe_dispatch.add(
+                1.0,
+                (backend, fe_backend, carry_mode, ed25519_path or "ladder"),
+            )
 
     def record_planner(self, present: int, dispatched: int,
                        compiled: bool = False) -> None:

@@ -95,6 +95,7 @@ class Profiler:
         bytes_to_device: int = 0,
         fe_backend: str = "",
         carry_mode: str = "",
+        ed25519_path: str = "",
         n_windows: int = 1,
         n_devices: int = 1,
     ) -> None:
@@ -112,6 +113,9 @@ class Profiler:
             # "" = host / not applicable) — the effective mode after
             # fe_common.effective_carry_mode's mxu16 degrade
             "carry_mode": str(carry_mode),
+            # verify strategy (ladder | msm; "" = host / not applicable):
+            # msm = one RLC Pippenger MSM per window (ops/ed25519_msm)
+            "ed25519_path": str(ed25519_path),
             "height_base": win[0] if win else None,
             "heights": heights or (win[1] if win else 0),
             "bucket": list(bucket),
@@ -199,6 +203,7 @@ class Profiler:
                     "kinds": [],
                     "fe_backends": [],
                     "carry_modes": [],
+                    "ed25519_paths": [],
                     "buckets": [],
                     "lanes_present": 0,
                     "lanes_dispatched": 0,
@@ -221,6 +226,9 @@ class Profiler:
             cm = e.get("carry_mode", "")
             if cm and cm not in row["carry_modes"]:
                 row["carry_modes"].append(cm)
+            ep = e.get("ed25519_path", "")
+            if ep and ep not in row["ed25519_paths"]:
+                row["ed25519_paths"].append(ep)
             if e["bucket"] and e["bucket"] not in row["buckets"]:
                 row["buckets"].append(e["bucket"])
             row["lanes_present"] += e["lanes_present"]

@@ -175,9 +175,14 @@ class Node(BaseService):
 
         # [verify] fe_backend: which limb multiplier serves device verify
         # windows (vpu schoolbook vs MXU int8-plane matmuls; ops/fe_common)
-        from tendermint_tpu.crypto.batch import set_default_fe_backend
+        from tendermint_tpu.crypto.batch import (
+            set_default_ed25519_path,
+            set_default_fe_backend,
+        )
 
         set_default_fe_backend(getattr(config.verify, "fe_backend", None))
+        # [verify] ed25519_path: per-row ladder vs one-MSM-per-window RLC
+        set_default_ed25519_path(getattr(config.verify, "ed25519_path", None))
 
         # [verify] planner knobs: pipeline depth, multi-window superdispatch
         # budget and the tally reduction side (parallel/planner.py)

@@ -119,7 +119,7 @@ def pt_add(p, q, d2, ksub, fe=_FE_VPU, kd=None):
         F = fe.sub(Dv, C, kd)
         G = fe.add(Dv, C)
         H = fe.add(B, A)
-        return fe.mul(E, F), fe.mul(G, H), fe.mul(F, G), fe.mul(E, H)
+        return fe.mul4(((E, F), (G, H), (F, G), (E, H)))
     A = fe.mul(fe.sub(Y1, X1, ksub), fe.sub(Y2, X2, ksub))
     B = fe.mul(fe.add(Y1, X1), fe.add(Y2, X2))
     C = fe.mul(fe.mul(T1, d2), T2)
@@ -144,7 +144,7 @@ def pt_madd(p, ypx, ymx, t2d, ksub, fe=_FE_VPU, kd=None):
         F = fe.sub(Dv, C, kd)
         G = fe.add(Dv, C)
         H = fe.add(B, A)
-        return fe.mul(E, F), fe.mul(G, H), fe.mul(F, G), fe.mul(E, H)
+        return fe.mul4(((E, F), (G, H), (F, G), (E, H)))
     A = fe.mul(fe.sub(Y1, X1, ksub), ymx)
     B = fe.mul(fe.add(Y1, X1), ypx)
     C = fe.mul(T1, t2d)
@@ -171,7 +171,7 @@ def pt_add_cached(p, c, ksub, kd, fe):
     F = fe.sub(Dv, C, kd)
     G = fe.add(Dv, C)
     H = fe.add(B, A)
-    return fe.mul(E, F), fe.mul(G, H), fe.mul(F, G), fe.mul(E, H)
+    return fe.mul4(((E, F), (G, H), (F, G), (E, H)))
 
 
 def pt_to_cached(p, d2, ksub, fe):
@@ -193,7 +193,7 @@ def pt_double(p, ksub, fe=_FE_VPU, kd=None):
         E = fe.sub(H, fe.mul_lazy(xy, xy), kd)
         G = fe.sub(A, B, kd)
         F = fe.add(C, G)
-        return fe.mul(E, F), fe.mul(G, H), fe.mul(F, G), fe.mul(E, H)
+        return fe.mul4(((E, F), (G, H), (F, G), (E, H)))
     A = fe.sq(X1)
     B = fe.sq(Y1)
     ZZ = fe.sq(Z1)
@@ -842,6 +842,89 @@ def verify_batch(pubs: np.ndarray, msgs: Sequence[bytes], sigs: np.ndarray,
             fe_backend, carry_mode,
         )
     return out
+
+
+def _prologue_h(pubs, msgs, sigs, interpret=False, device=None) -> list:
+    """h_i = SHA-512(R || A || M) mod L for every row, computed by the
+    ON-DEVICE prologue kernel: one _prologue_call per uniform-msg-length
+    group, then the (NWIN, b) MSB-first 4-bit digit matrix reassembles to
+    host ints for the MSM schedule builder.  This keeps the hash stage of
+    the RLC path on the same kernel the ladder uses."""
+    n = pubs.shape[0]
+    lanes = 8 if interpret else LANES
+    lens = np.array([len(m) for m in msgs]) if msgs else np.zeros((0,), int)
+    hs = [0] * n
+    put = (lambda a: jax.device_put(a, device)) if device is not None else jnp.asarray
+    for ln in np.unique(lens):
+        idx = np.nonzero(lens == ln)[0]
+        k = len(idx)
+        b = _bucket(k, lanes)
+        total = 64 + int(ln)
+        nblocks = (total + 1 + 16 + 127) // 128
+        padded = np.zeros((b, nblocks * 128), dtype=np.uint8)
+        padded[:k, :32] = sigs[idx, :32]
+        padded[:k, 32:64] = pubs[idx]
+        if ln:
+            m = np.frombuffer(
+                b"".join(bytes(msgs[i]) for i in idx), np.uint8
+            ).reshape(k, int(ln))
+            padded[:k, 64:total] = m
+        padded[:, total] = 0x80
+        padded[:, -16:] = np.frombuffer((total * 8).to_bytes(16, "big"), np.uint8)
+        msg_words = padded.reshape(b, -1, 4)[:, :, ::-1].reshape(b, -1)
+        msg_words = np.ascontiguousarray(msg_words).view("<u4").astype(np.uint32)
+        sig_words = np.ascontiguousarray(sigs[idx]).view("<u4").astype(np.uint32)
+        _, digh, _, _ = _prologue_call(
+            put(msg_words.T), put(_pad_rows(sig_words, b).T),
+            interpret=interpret, lanes=lanes,
+        )
+        digh = np.asarray(digh)
+        for j, i in enumerate(idx):
+            h = 0
+            for t in range(NWIN):
+                h = (h << 4) | int(digh[t, j])
+            hs[i] = h
+    return hs
+
+
+def rlc_verify_batch(pubs: np.ndarray, msgs: Sequence[bytes],
+                     sigs: np.ndarray, interpret: bool = False, device=None,
+                     fe_backend: str = "vpu", carry_mode: str = "lazy",
+                     seed: Optional[int] = None) -> np.ndarray:
+    """Batched Go-exact verify via ONE multi-scalar multiplication on the
+    Pallas path: the SHA-512/mod-L stage runs in the existing prologue
+    kernel (_prologue_h), the MSM itself in the shared device engine
+    (ops/ed25519_msm), and a rejected window localizes through chunk RLCs
+    down to exact rows on this module's ladder ``verify_batch``.  Same
+    contract as ``verify_batch``; ``seed`` pins the RLC coefficients."""
+    from tendermint_tpu.ops import ed25519_msm as _msm
+
+    fe_backend = _fc.normalize_backend(fe_backend)
+    carry_mode = _fc.normalize_carry_mode(carry_mode)
+    pubs = np.ascontiguousarray(pubs, dtype=np.uint8)
+    sigs = np.ascontiguousarray(sigs, dtype=np.uint8)
+    n = pubs.shape[0]
+    if n == 0:
+        return np.zeros((0,), dtype=bool)
+    items = [(pubs[i].tobytes(), bytes(msgs[i]), sigs[i].tobytes())
+             for i in range(n)]
+    parsed, out = _ed._parse_batch(items, compute_h=False)
+    if parsed:
+        hs = _prologue_h(pubs, msgs, sigs, interpret=interpret, device=device)
+        parsed = [(i, na, nr, int(hs[i]), s) for (i, na, nr, _h, s) in parsed]
+    if seed is None:
+        seed = _xla.rlc_seed(pubs, sigs)
+
+    def ladder_fn(idx):
+        return verify_batch(
+            pubs[idx], [msgs[i] for i in idx], sigs[idx],
+            interpret=interpret, device=device,
+            fe_backend=fe_backend, carry_mode=carry_mode,
+        )
+
+    _msm.rlc_resolve(parsed, out, ladder_fn, seed=seed,
+                     fe_backend=fe_backend, carry_mode=carry_mode)
+    return np.asarray(out, dtype=bool)
 
 
 def pack_variable_words(pubs, msgs, sigs, ln: int, b: int):

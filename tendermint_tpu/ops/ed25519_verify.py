@@ -213,6 +213,18 @@ def fe_sub_l(a, b):
     return fe_norm1(a + _KD_SUB - b)
 
 
+def fe_mul4_f(*pairs):
+    """Four mulF schedules stacked into ONE wide round (PERF.md carry-tail
+    vectorization): the four output products of a point op share the exact
+    same fold/wide/fixup schedule, so stacking them on a new leading axis
+    runs one (4, ..., 20) reduction instead of four — bit-identical per
+    slice (every fe op is elementwise over leading axes)."""
+    a = jnp.stack([p[0] for p in pairs])
+    b = jnp.stack([p[1] for p in pairs])
+    out = fe_mul_f(a, b)
+    return tuple(out[k] for k in range(len(pairs)))
+
+
 def fe_inv(z):
     """z^(p-2) by square-and-multiply over the fixed bit pattern of p-2."""
 
@@ -274,7 +286,7 @@ def pt_add(p, q, d2):
         F = fe_sub_l(Dv, C)
         G = fe_add_l(Dv, C)
         H = fe_add_l(B, A)
-        return fe_mul_f(E, F), fe_mul_f(G, H), fe_mul_f(F, G), fe_mul_f(E, H)
+        return fe_mul4_f((E, F), (G, H), (F, G), (E, H))
     A = fe_mul(fe_sub(Y1, X1), fe_sub(Y2, X2))
     B = fe_mul(fe_add(Y1, X1), fe_add(Y2, X2))
     C = fe_mul(fe_mul(T1, d2), T2)
@@ -298,7 +310,7 @@ def pt_double(p):
         E = fe_sub_l(H, fe_mul_l(xy, xy))
         G = fe_sub_l(A, B)
         F = fe_add_l(C, G)
-        return fe_mul_f(E, F), fe_mul_f(G, H), fe_mul_f(F, G), fe_mul_f(E, H)
+        return fe_mul4_f((E, F), (G, H), (F, G), (E, H))
     A = fe_sq(X1)
     B = fe_sq(Y1)
     ZZ = fe_sq(Z1)
@@ -549,3 +561,57 @@ def verify_batch(
         args = [jax.device_put(a, data) for a in args]
     ok = np.asarray(_compiled_kernel(b, mesh, fe_backend, carry_mode)(*args))[:n]
     return ok & valid
+
+
+def rlc_seed(pubs: np.ndarray, sigs: np.ndarray) -> int:
+    """Deterministic RLC coefficient seed: SHA-256 over the batch content.
+    The coefficients must only be unpredictable *before* the signatures are
+    fixed (Fiat–Shamir style), so hashing the batch keeps the 2^-128
+    soundness while making audit/replay runs reproduce the same verdict
+    path bit-for-bit."""
+    dig = hashlib.sha256(
+        b"ed25519-rlc" + pubs.tobytes() + sigs.tobytes()
+    ).digest()
+    return int.from_bytes(dig[:8], "little")
+
+
+def rlc_verify_batch(
+    pubs: np.ndarray,
+    msgs: Sequence[bytes],
+    sigs: np.ndarray,
+    fe_backend: str = "vpu",
+    carry_mode: str = "lazy",
+    seed: Optional[int] = None,
+) -> np.ndarray:
+    """Batched Go-exact verify via ONE device multi-scalar multiplication.
+
+    Same contract as ``verify_batch`` (per-row verdicts, every Go edge
+    honored) at a fraction of the curve work: the whole batch is accepted
+    by a single random-linear-combination MSM (ops/ed25519_msm); a rejected
+    batch localizes through host chunk RLCs and re-runs only the dirty rows
+    on the exact per-row ladder above.  ``seed`` pins the RLC coefficients
+    (default: derived from the batch content — deterministic replay)."""
+    from tendermint_tpu.ops import ed25519_msm as _msm
+
+    fe_backend = _fc.normalize_backend(fe_backend)
+    carry_mode = _fc.normalize_carry_mode(carry_mode)
+    pubs = np.ascontiguousarray(pubs, dtype=np.uint8)
+    sigs = np.ascontiguousarray(sigs, dtype=np.uint8)
+    n = pubs.shape[0]
+    if n == 0:
+        return np.zeros((0,), dtype=bool)
+    items = [(pubs[i].tobytes(), bytes(msgs[i]), sigs[i].tobytes())
+             for i in range(n)]
+    parsed, out = _ed._parse_batch(items)
+    if seed is None:
+        seed = rlc_seed(pubs, sigs)
+
+    def ladder_fn(idx: List[int]) -> np.ndarray:
+        return verify_batch(
+            pubs[idx], [msgs[i] for i in idx], sigs[idx],
+            fe_backend=fe_backend, carry_mode=carry_mode,
+        )
+
+    _msm.rlc_resolve(parsed, out, ladder_fn, seed=seed,
+                     fe_backend=fe_backend, carry_mode=carry_mode)
+    return np.asarray(out, dtype=bool)

@@ -608,6 +608,67 @@ def ed_fe_norm1(raw, fix=(0,)):
     return fix_rows(wide_carry_rows(raw, ED_WRAP), fix)
 
 
+def shift_rows_up(x, k):
+    """Rows move -k (bottom k rows become 0) — inverse of shift_rows_down."""
+    if k == 0:
+        return x
+    return jnp.pad(x[k:, :], ((0, k), (0, 0)))
+
+
+def wide_carry_rows_stacked(x, wrap):
+    """wide_carry_rows over a (nblk·NLIMB, B) stack of independent operands
+    (PERF.md carry-tail vectorization): carries ripple within each NLIMB-row
+    block only — the ripple entering each block's row 0 is masked off, and
+    each block's own top carry wraps back into its low rows via in-block
+    up-shifts.  Bit-identical to nblk separate wide_carry_rows calls; built
+    from pads/wheres/iota only, so it lowers inside Pallas kernels."""
+    rows = x.shape[0]
+    blockrow = lax.broadcasted_iota(jnp.uint32, (rows, 1), 0) % NLIMB
+    c = x >> BITS
+    out = (x & MASK) + jnp.where(blockrow == 0, 0, shift_rows_down(c))
+    top = jnp.where(blockrow == NLIMB - 1, c, 0)
+    for row, mult, sh in wrap:
+        # top carry sits at local row NLIMB-1; its (lo, hi) wrap pieces land
+        # at local rows `row` / `row + 1` of the SAME block
+        out = out + shift_rows_up(((top & MASK) * mult) << sh,
+                                  NLIMB - 1 - row)
+        out = out + shift_rows_up(((top >> BITS) * mult) << sh,
+                                  NLIMB - 2 - row)
+    return out
+
+
+def fix_rows_stacked(x, fix):
+    """fix_rows over a (nblk·NLIMB, B) stack: each fixup row r carries to
+    r + 1 within every block (plans only fix rows < NLIMB - 1, so the shift
+    cannot cross a block boundary)."""
+    rows = x.shape[0]
+    blockrow = lax.broadcasted_iota(jnp.uint32, (rows, 1), 0) % NLIMB
+    for r in fix:
+        assert r < NLIMB - 1, "stacked fixup would cross a block boundary"
+        c = jnp.where(blockrow == r, x >> BITS, 0)
+        x = x - (c << BITS) + shift_rows_down(c)
+    return x
+
+
+def ed_fe_mul4_lazy(pairs, wide, fix=(0,), backend="vpu"):
+    """Four deferred-carry multiplies sharing ONE stacked carry tail: the
+    product columns and fold stay per-product (MXU/VPU bound), but the
+    `wide` rounds and row fixups — the ~40% carry tail — run once over the
+    (4·NLIMB, B) concatenation.  The four output products of a point op
+    share the exact same schedule, which is what makes the stacking sound;
+    bit-identical to four ed_fe_mul_lazy calls."""
+    lo = jnp.concatenate(
+        [ed_fold_fused_rows(mul_columns_rows(a, b, 2 * NLIMB, backend,
+                                             split=8))
+         for a, b in pairs],
+        axis=0,
+    )
+    for _ in range(wide):
+        lo = wide_carry_rows_stacked(lo, ED_WRAP)
+    lo = fix_rows_stacked(lo, fix)
+    return tuple(lo[k * NLIMB:(k + 1) * NLIMB, :] for k in range(len(pairs)))
+
+
 def secp_fold_fused_rows(cols):
     """(41, B) raw product columns -> (24, B) temp: rows 20..40 fold as
     2^(260+13k) = (2^36 + 15632)·2^13k with each high column decomposed
@@ -765,6 +826,8 @@ def make_fe(curve: str, backend: str = "vpu",
             curve=curve, backend=backend, carry_mode="lazy", plan=plan,
             kd=np.asarray(plan.kd, np.uint32),
             mul=mul,
+            mul4=partial(ed_fe_mul4_lazy, wide=plan.mulf_wide,
+                         fix=plan.mulf_fix, backend=backend),
             mul_lazy=partial(ed_fe_mul_lazy, wide=plan.mull_wide,
                              fix=plan.mull_fix, backend=backend),
             sq=lambda a: mul(a, a),

@@ -43,6 +43,8 @@ class RPCEnv:
         self._broadcast_mtx = threading.Lock()
         self._broadcast_in_flight = 0
         self.broadcast_shed: Dict[str, int] = {}
+        self._feed_mtx = threading.Lock()
+        self._feed = None  # lazy shared planner LaneFeed (commit verify)
 
     # load-shedding: broadcast_tx_* share one bounded in-flight budget; at
     # the cap new submissions fail fast with a mempool-overloaded error
@@ -256,14 +258,68 @@ class RPCEnv:
             },
         }
 
-    def commit(self, height: Optional[int] = None) -> dict:
+    def _lane_feed(self):
+        """Shared planner LaneFeed serving RPC commit-verification bursts:
+        concurrent /commit?verify=1 and /validators?verify=1 queries park
+        their signature rows here and fold into ONE lane-packed planner
+        dispatch (verify_windows semantics, breaker + host-fallback guard
+        unchanged) instead of each paying a serial per-signature loop."""
+        with self._feed_mtx:
+            if self._feed is None:
+                from tendermint_tpu.parallel.planner import LaneFeed
+
+                self._feed = LaneFeed(profile_kind="rpc_lane_feed")
+            return self._feed
+
+    def _verify_stored_commit(self, h: int) -> dict:
+        """Verify the stored commit at height h against its validator set
+        through the shared LaneFeed; returns JSON-able verdict facts."""
+        from tendermint_tpu.parallel.planner import rows_from_commit
+        from tendermint_tpu.state import store as sm_store
+        from tendermint_tpu.types.validator_set import CommitError
+
+        bs = self.node.block_store
+        commit = bs.load_block_commit(h) or bs.load_seen_commit(h)
+        if commit is None:
+            raise RPCError(-32603, f"no commit for height {h}")
+        try:
+            vals = sm_store.load_validators(self.node.state_db, h)
+        except Exception as e:
+            raise RPCError(-32603, f"no validators for {h}: {e}")
+        try:
+            pubkeys, msgs, sigs, powers = vals.collect_commit_sigs(
+                self.node.genesis_doc.chain_id, commit.block_id, h, commit
+            )
+        except CommitError as e:
+            return {"verified": False, "reason": str(e)}
+        vrow, prow = rows_from_commit(
+            commit.precommits, pubkeys, msgs, sigs, powers
+        )
+        ticket = self._lane_feed().submit(
+            vrow, prow, vals.total_voting_power()
+        )
+        try:
+            v = ticket.result(60.0)
+        except TimeoutError:
+            raise RPCError(-32603, f"commit verification timed out for {h}")
+        return {
+            "verified": bool(v.committed),
+            "sigs_ok": bool(v.sigs_ok),
+            "tally": int(v.tally),
+            "total_power": int(vals.total_voting_power()),
+            # realized aggregation of the dispatch this row rode in
+            "batch_rows": int(v.batch_rows),
+            "batch_lanes": int(v.batch_lanes),
+        }
+
+    def commit(self, height: Optional[int] = None, verify=None) -> dict:
         bs = self.node.block_store
         h = int(height) if height else bs.height()
         meta = bs.load_block_meta(h)
         if meta is None:
             raise RPCError(-32603, f"no commit for height {h}")
         commit = bs.load_block_commit(h) or bs.load_seen_commit(h)
-        return {
+        out = {
             "signed_header": {
                 "header": _header_json(meta.header),
                 "commit": {
@@ -273,6 +329,9 @@ class RPCEnv:
             },
             "canonical": bs.load_block_commit(h) is not None,
         }
+        if verify:
+            out["verification"] = self._verify_stored_commit(h)
+        return out
 
     def lite_full_commit(self, height: Optional[int] = None) -> dict:
         """Codec-exact light-client material: header+commit+valsets as b64
@@ -302,12 +361,12 @@ class RPCEnv:
             "next_validators": _b64(next_vals.marshal()),
         }
 
-    def validators(self, height: Optional[int] = None) -> dict:
+    def validators(self, height: Optional[int] = None, verify=None) -> dict:
         from tendermint_tpu.state import store as sm_store
 
         h = int(height) if height else self.node.block_store.height() + 1
         vals = sm_store.load_validators(self.node.state_db, h)
-        return {
+        out = {
             "block_height": h,
             "validators": [
                 {
@@ -319,6 +378,12 @@ class RPCEnv:
                 for v in vals.validators
             ],
         }
+        if verify:
+            # prove the set actually signed: verify the stored commit AT
+            # this height (signed by exactly this valset) through the
+            # shared LaneFeed
+            out["verification"] = self._verify_stored_commit(h)
+        return out
 
     def dump_consensus_state(self) -> dict:
         rs = self.node.consensus_state.get_round_state()

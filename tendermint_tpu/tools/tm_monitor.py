@@ -85,6 +85,28 @@ def _phase_label(key: str, family: str) -> Optional[str]:
     return key[i + 7 : j] if j > i else None
 
 
+def _verify_path(metrics: Dict[str, float]) -> str:
+    """Which ed25519 verify strategy served device windows, from the
+    `ed25519_path` label of verify_fe_backend_total: "ladder", "msm",
+    "mixed" when both appear, "-" when no device dispatch recorded."""
+    fam = "tendermint_verify_fe_backend_total{"
+    seen = set()
+    for k, v in metrics.items():
+        if not k.startswith(fam) or v <= 0:
+            continue
+        i = k.find('ed25519_path="')
+        if i < 0:
+            continue
+        j = k.find('"', i + 14)
+        if j > i:
+            seen.add(k[i + 14 : j])
+    if not seen:
+        return "-"
+    if len(seen) > 1:
+        return "mixed"
+    return seen.pop()
+
+
 def _crit_column(metrics: Dict[str, float]) -> str:
     """Dominant commit-path phase from the height_phase_seconds family:
     `phase avg_ms` where avg is the per-height mean of the phase with the
@@ -123,6 +145,7 @@ class NodeMonitor:
         self.offline_since: Optional[float] = None
         # hot-path columns from /metrics
         self.verify_ms = 0.0  # avg verify-dispatch latency
+        self.verify_path = "-"  # ed25519 strategy (ladder | msm | mixed)
         self.traffic_bytes = 0.0  # total per-peer send+recv wire bytes
         # liveness-watchdog columns (tendermint_consensus_stall*)
         self.stalls_total = 0
@@ -181,6 +204,7 @@ class NodeMonitor:
         c = _sum_family(m, "tendermint_verify_dispatch_seconds_count")
         if c > 0:
             self.verify_ms = round(1e3 * s / c, 1)
+        self.verify_path = _verify_path(m)
         self.traffic_bytes = _sum_family(
             m, "tendermint_p2p_peer_send_bytes_total"
         ) + _sum_family(m, "tendermint_p2p_peer_receive_bytes_total")
@@ -246,6 +270,7 @@ class NodeMonitor:
             "height": self.height,
             "block_interval_ms": self.block_latency_ms,
             "verify_ms": self.verify_ms,
+            "verify_path": self.verify_path,
             "traffic_bytes": self.traffic_bytes,
             "stalls_total": self.stalls_total,
             "stall_seconds": self.stall_seconds,
@@ -296,6 +321,13 @@ class NetworkMonitor:
 _DEVICE_LABEL = {0: "ok", 1: "OPEN", 2: "PROBE", 3: "QUAR"}
 
 
+def _fmt_verify(ms: float, path: str) -> str:
+    """VERIFY column: mean dispatch latency, annotated with the ed25519
+    strategy once a device window has dispatched (ladder | msm | mixed)."""
+    base = f"{ms}ms"
+    return base if path in ("-", "") else f"{base}/{path}"
+
+
 def _fmt_device(state: int, fallbacks: int) -> str:
     if state < 0:
         return "-"
@@ -332,7 +364,7 @@ def main(argv=None) -> int:
                       f"({snap['num_online']}/{snap['num_nodes']} online, "
                       f"height {snap['max_height']})")
                 print(f"{'MONIKER':<16}{'HEIGHT':>8}{'INTERVAL':>10}"
-                      f"{'VERIFY':>9}{'DEVICE':>10}{'CRIT':>15}"
+                      f"{'VERIFY':>14}{'DEVICE':>10}{'CRIT':>15}"
                       f"{'TRAFFIC':>10}{'STALL':>9}{'UPTIME':>8}  ADDR")
                 for n in snap["nodes"]:
                     if n["online"]:
@@ -352,7 +384,7 @@ def main(argv=None) -> int:
                     print(
                         f"{n['moniker']:<16}{n['height']:>8}"
                         f"{n['block_interval_ms']:>9}ms"
-                        f"{n['verify_ms']:>7}ms"
+                        f"{_fmt_verify(n['verify_ms'], n.get('verify_path', '-')):>14}"
                         f"{_fmt_device(n['device_state'], n['device_fallbacks']):>10}"
                         f"{n['crit']:>15}"
                         f"{_fmt_bytes(n['traffic_bytes']):>10}"
