@@ -7,6 +7,7 @@ PYTHON ?= python
         lite-bench multichip-bench vote-bench metrics-lint bench-check \
         statesync-smoke \
         flight-smoke chaos-smoke critpath-smoke critpath-bench \
+        quorum-smoke \
         localnet-start localnet-stop build-docker-localnode
 
 test:
@@ -118,6 +119,17 @@ flight-smoke:
 # the merged trace must carry strictly nested waterfall slices
 critpath-smoke:
 	JAX_PLATFORMS=cpu $(PYTHON) scripts/critpath_smoke.py
+
+# quorum observatory end to end on the sim fabric: 4 validators (one
+# silenced) with vote batching on; per-validator journeys must reconcile
+# exactly with receiver first-sighting records after skew correction, the
+# gossip waste ratio must be finite-positive, the merged trace must carry
+# paired signer->receiver flow arrows, and the appended QUORUM_rNN.json
+# round gates quorum_time_to_two_thirds_p99_seconds (lower is better)
+quorum-smoke:
+	JAX_PLATFORMS=cpu $(PYTHON) scripts/quorum_smoke.py
+	$(PYTHON) scripts/bench_check.py --prefix QUORUM \
+	  --metric quorum_time_to_two_thirds_p99_seconds:0.25:lower
 
 # signing-to-commit p99 under vote_storm + mempool_flood on the sim
 # fabric, pooled from every node's critical-path waterfalls; appends a

@@ -65,6 +65,10 @@ DEFAULT_METRICS = [
     # batched signed-tx ingest headline (scripts/bench_mempool.py --signed /
     # make mempool-bench ARGS=--signed — MEMPOOL_r*.json rounds via --prefix)
     "mempool_signed_checktx_per_s:0.25:higher",
+    # pooled honest-node time-to-strict-2/3 tail from the quorum
+    # observatory (scripts/quorum_smoke.py / make quorum-smoke —
+    # QUORUM_r*.json rounds via --prefix); latency: lower is better
+    "quorum_time_to_two_thirds_p99_seconds:0.25:lower",
 ]
 DEFAULT_THRESHOLD = 0.20
 

@@ -174,13 +174,15 @@ def validate_chrome_trace(merged, n_nodes, min_commits_per_node):
 
     named_pids = set()
     commits_by_pid = {}
+    flow_starts = {}  # flow id -> start ts (ph "s")
+    flow_ends = {}  # flow id -> end ts (ph "f")
     for n, ev in enumerate(events):
         where = f"event {n}"
         for key in ("name", "ph", "pid"):
             if key not in ev:
                 errors.append(f"{where}: missing {key!r}")
         ph = ev.get("ph")
-        if ph not in ("X", "i", "M"):
+        if ph not in ("X", "i", "M", "s", "f"):
             errors.append(f"{where}: unexpected ph {ph!r}")
             continue
         if ph == "M":
@@ -199,8 +201,32 @@ def validate_chrome_trace(merged, n_nodes, min_commits_per_node):
                 errors.append(f"{where}: X event bad dur {ev.get('dur')!r}")
         if ph == "i" and ev.get("s") not in ("t", "p", "g"):
             errors.append(f"{where}: instant without scope 's'")
+        if ph in ("s", "f"):
+            fid = ev.get("id")
+            if fid is None:
+                errors.append(f"{where}: flow event without id")
+                continue
+            book = flow_starts if ph == "s" else flow_ends
+            if fid in book:
+                errors.append(f"{where}: duplicate flow {ph!r} for id {fid}")
+            book[fid] = ev.get("ts")
         if ev.get("name") == "commit":
             commits_by_pid[ev["pid"]] = commits_by_pid.get(ev["pid"], 0) + 1
+
+    # every flow id must have BOTH endpoints, and the arrow must not point
+    # backward in time (trace_merge clamps finish >= start in µs space)
+    for fid in sorted(set(flow_starts) | set(flow_ends)):
+        if fid not in flow_starts:
+            errors.append(f"flow id {fid}: finish without start (dangling)")
+        elif fid not in flow_ends:
+            errors.append(f"flow id {fid}: start without finish (dangling)")
+        elif isinstance(flow_starts[fid], (int, float)) and isinstance(
+            flow_ends[fid], (int, float)
+        ) and flow_ends[fid] < flow_starts[fid]:
+            errors.append(
+                f"flow id {fid}: finish ts {flow_ends[fid]} before start "
+                f"ts {flow_starts[fid]}"
+            )
 
     for pid in range(n_nodes):
         if pid not in named_pids:

@@ -363,6 +363,14 @@ def _self_check():
     nm.mempool_tx_size_bytes.observe(512.0)
     nm.mempool_failed_txs.add(1.0)
     nm.mempool_recheck_times.add(2.0)
+    # quorum observatory families: the receive-seam sighting split (both
+    # outcomes, chID label format shared with peer traffic) and the
+    # time-to-quorum histograms (one series per vote kind)
+    nm.record_vote_sighting("f3a1", 0x22, first=True)
+    nm.record_vote_sighting("f3a1", 0x22, first=False)
+    nm.record_vote_sighting("b7c2", 0x22, first=True)
+    nm.quorum_time_to_third.observe(0.012, ("prevote",))
+    nm.quorum_time_to_two_thirds.observe(0.045, ("precommit",))
     nm.forget_peer("f3a1")  # removal must leave the exposition lintable
 
     failures = []
@@ -408,6 +416,28 @@ def _self_check():
         failures.append(
             ("critpath family parity",
              [f"missing {n}" for n in missing_cp])
+        )
+    # quorum-observatory family parity: the time-to-quorum histograms feed
+    # tm_monitor's QUORUM column and the quorum_report runbook, and the
+    # sighting/duplicate counters must keep the receive-seam sum invariant
+    # scrapeable under these exact names (libs/quorumtrace.py + the
+    # consensus reactor's _note_vote_arrival wire them)
+    quorum_names = (
+        "tendermint_consensus_quorum_time_to_third_seconds",
+        "tendermint_consensus_quorum_time_to_two_thirds_seconds",
+        "tendermint_p2p_vote_first_sighting_total",
+        "tendermint_p2p_duplicate_votes_total",
+    )
+    missing_q = [
+        n for n in quorum_names if f"# TYPE {n} " not in node_text
+    ]
+    missing_q.extend(
+        f'vote-kind label "{k}"' for k in ("prevote", "precommit")
+        if f'type="{k}"' not in node_text
+    )
+    if missing_q:
+        failures.append(
+            ("quorum family parity", [f"missing {n}" for n in missing_q])
         )
     # device-guard family parity: the breaker gauge + fallback/retry/audit
     # counters tm_monitor's DEVICE column and the runbooks scrape must keep

@@ -1,0 +1,434 @@
+"""Quorum observatory smoke test (`make quorum-smoke`).
+
+Drives the cross-node quorum observatory end to end, in one process, on
+CPU, over the REAL gossip stack: a 4-validator `build_sim_net` mesh (real
+ConsensusReactors over the seeded InProcSwitch fabric) with the live-vote
+micro-batcher on, one validator silenced at the fabric, and a mild
+seeded duplicate policy so the gossip ledger has waste to account:
+
+  1. run consensus past a target height; every node's flight recorder
+     stamps sign/first-send/arrival/contribution and the per-node
+     QuorumTrace analyzer cuts completion curves at each finalize;
+  2. assert the dump_quorum contract on every live node (records present,
+     limit/truncated consistent, zero analyzer errors) and that every
+     honest node's precommit curve crossed the strict 2/3 threshold with
+     a pivotal validator named — never the silenced one;
+  3. fuse all dumps with scripts/quorum_report.py and require: the
+     silenced validator absent from EVERY height's quorums, a finite
+     waste ratio > 0, and every journey arrival reconciling EXACTLY
+     (integer ns) with the receiver's first-sighting record after
+     commit-anchor skew correction;
+  4. reconcile the receive-seam metric counters: per node,
+     first sightings + duplicates must equal the total VoteMessages the
+     reactor received (PeerState.stats_votes ground truth);
+  5. require the vote feed to have dispatched (batching demonstrably on)
+     with flush records attributed to committed heights, and lint every
+     exposition (quorum histograms, sighting counters, batch-wait
+     histogram) with the strict metrics_lint parser;
+  6. merge the flight dumps with scripts/trace_merge.py and strict-
+     validate the result as Chrome trace — including the signer->receiver
+     flow arrows (paired s/f events, no dangling ids, no backward
+     arrows);
+  7. append a QUORUM_rNN.json round whose parsed
+     quorum_time_to_two_thirds_p99_seconds feeds `make quorum-smoke`'s
+     bench_check regression gate.
+
+Exit code 0 means stamping, fusion, skew correction, attribution,
+exposition, and the merged flow view all work end to end on this machine.
+"""
+
+import glob
+import json
+import math
+import os
+import re
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _ROOT)
+
+import flight_smoke  # noqa: E402  (sibling script: chrome-trace validator)
+import quorum_report  # noqa: E402  (sibling script)
+import trace_merge  # noqa: E402  (sibling script)
+from metrics_lint import lint_text  # noqa: E402  (sibling script)
+
+from tendermint_tpu.config.config import test_config  # noqa: E402
+from tendermint_tpu.libs.metrics import get_vote_batch_metrics  # noqa: E402
+from tendermint_tpu.libs.quorumtrace import percentile  # noqa: E402
+from tendermint_tpu.sim.node import build_sim_net  # noqa: E402
+from tendermint_tpu.sim.simnet import LinkPolicy  # noqa: E402
+
+N_VALS = 4
+SILENCED = 3  # validator index == sim node index (sorted valset order)
+TARGET_HEIGHT = 5
+SEED = 21
+# seeded fabric-level duplication so re-gossip waste is guaranteed to show
+# up in the ledger without depending on HasVote race timing
+DUP_POLICY = LinkPolicy(duplicate=0.25)
+
+
+def _config():
+    cfg = test_config()
+    # live-vote micro-batcher on: peer votes verify through VoteFeed and
+    # the flush ledger feeds the batch attribution report
+    cfg.verify.vote_batch_window_ms = 2.0
+    cfg.verify.vote_batch_rows = 64
+    return cfg
+
+
+def _wait(pred, timeout: float, interval: float = 0.05) -> bool:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def _check_quorum_snapshot(snap: dict, node: str, failures: list) -> None:
+    """The dump_quorum contract (mirrors dump_flight/dump_critpath)."""
+    recs = snap["records"]
+    if snap["total_records"] < TARGET_HEIGHT - 1:
+        failures.append(
+            f"{node}: only {snap['total_records']} quorum records "
+            f"(need >= {TARGET_HEIGHT - 1})"
+        )
+    if snap["truncated"]:
+        failures.append(f"{node}: unlimited snapshot claims truncated")
+    if len(recs) != snap["total_records"]:
+        failures.append(
+            f"{node}: {len(recs)} records shipped vs "
+            f"total_records={snap['total_records']}"
+        )
+    if snap["analysis_errors"]:
+        failures.append(f"{node}: {snap['analysis_errors']} analyzer errors")
+    for rec in recs:
+        h = rec["height"]
+        two = rec["curves"].get("precommit", {}).get(
+            "crossings", {}).get("two_thirds")
+        if two is None:
+            failures.append(
+                f"{node} h={h}: committed without a strict-2/3 precommit "
+                f"crossing in the curve"
+            )
+            continue
+        piv = rec["curves"]["precommit"]["pivotal_validator"]
+        if piv is None or not (0 <= piv < N_VALS):
+            failures.append(f"{node} h={h}: bogus pivotal validator {piv!r}")
+        if two["seconds"] < 0:
+            failures.append(
+                f"{node} h={h}: negative time-to-quorum {two['seconds']}"
+            )
+
+
+def _reconcile_journeys(report: dict, flights: list, failures: list) -> int:
+    """Every journey arrival must equal the receiver's raw first-sighting
+    stamp plus that receiver's anchor skew — exact integer ns."""
+    by_node = {d.get("node_id"): d for d in flights}
+    skews = report["skews_ns"]
+    checked = 0
+    for j in report["journeys"]:
+        for node, arr in j["arrivals"].items():
+            dump = by_node.get(node)
+            rec = next(
+                (r for r in (dump or {}).get("records", [])
+                 if r.get("height") == j["height"]),
+                None,
+            )
+            if rec is None:
+                failures.append(
+                    f"journey h={j['height']} {j['kind']} "
+                    f"v{j['validator_index']}: receiver {node} has no "
+                    f"flight record for the height"
+                )
+                continue
+            slot = rec.get(j["kind"]) or {}
+            arrivals = slot.get("arrivals") or {}
+            mark = arrivals.get(j["validator_index"])
+            if mark is None:  # JSON round-tripped dumps carry str keys
+                mark = arrivals.get(str(j["validator_index"]))
+            if mark is None:
+                failures.append(
+                    f"journey h={j['height']} {j['kind']} "
+                    f"v{j['validator_index']}: no first-sighting record "
+                    f"on {node}"
+                )
+                continue
+            want = int(mark["t"]) + int(skews.get(node, 0))
+            if int(arr["t_ns"]) != want:
+                failures.append(
+                    f"journey h={j['height']} {j['kind']} "
+                    f"v{j['validator_index']} -> {node}: corrected arrival "
+                    f"{arr['t_ns']} != receiver record {want}"
+                )
+            checked += 1
+    return checked
+
+
+def _check_flow_events(merged: dict, failures: list) -> None:
+    """The merged trace must carry signer->receiver vote flow arrows."""
+    flows = [
+        ev for ev in merged["traceEvents"]
+        if ev.get("cat") == "flow" and ev.get("ph") in ("s", "f")
+    ]
+    if not flows:
+        failures.append("merged trace has no vote flow events")
+        return
+    starts = {ev["id"] for ev in flows if ev["ph"] == "s"}
+    ends = {ev["id"] for ev in flows if ev["ph"] == "f"}
+    if starts != ends:
+        failures.append(
+            f"flow ids unpaired: {len(starts ^ ends)} dangling"
+        )
+
+
+def _write_round(round_dir: str, parsed: dict) -> str:
+    ns = [
+        int(m.group(1))
+        for p in glob.glob(os.path.join(round_dir, "QUORUM_r*.json"))
+        if (m := re.search(r"QUORUM_r(\d+)\.json$", os.path.basename(p)))
+    ]
+    path = os.path.join(
+        round_dir, f"QUORUM_r{max(ns, default=0) + 1:02d}.json"
+    )
+    with open(path, "w") as f:
+        json.dump({"rc": 0, "parsed": parsed}, f, indent=2)
+        f.write("\n")
+    print(f"[quorum-smoke] round -> {path}")
+    return path
+
+
+def main() -> int:
+    failures = []
+    fabric, nodes = build_sim_net(N_VALS, seed=SEED, config=_config())
+    silenced_id = nodes[SILENCED].node_id
+    honest = [n for n in nodes if n.node_id != silenced_id]
+    fabric.set_policy(None, None, DUP_POLICY)
+    fabric.silence({silenced_id})
+    try:
+        fabric.start()
+        for n in nodes:
+            n.start()
+        print(f"[quorum-smoke] running {N_VALS}-node net "
+              f"({silenced_id} silenced) to height {TARGET_HEIGHT}...")
+        ok = _wait(
+            lambda: all(n.height > TARGET_HEIGHT for n in honest),
+            timeout=90.0,
+        )
+        if not ok:
+            return _fail([
+                f"net never reached height {TARGET_HEIGHT + 1}: "
+                f"heights={[n.height for n in nodes]}"
+            ])
+
+        # collect EVERYTHING before stop(): peer teardown runs
+        # forget_peer, which prunes the per-peer counter series
+        flights = [n.cs.flight.snapshot() for n in nodes]
+        quorums = [n.cs.quorumtrace.snapshot() for n in nodes]
+        votes_received = {
+            n.node_id: sum(
+                ps.stats_votes
+                for o in nodes
+                if o is not n
+                and (ps := n.reactor.peer_state(o.node_id)) is not None
+            )
+            for n in nodes
+        }
+        sighting_counts = {
+            n.node_id: (
+                sum(n.metrics.vote_first_sighting._values.values()),
+                sum(n.metrics.duplicate_votes._values.values()),
+            )
+            for n in nodes
+        }
+        feed_dispatches = {
+            n.node_id: (0 if n.vote_feed is None else n.vote_feed.dispatches)
+            for n in nodes
+        }
+        expositions = {
+            n.node_id: n.metrics.registry.expose_text() for n in nodes
+        }
+    finally:
+        for n in nodes:
+            n.stop()
+        fabric.stop()
+
+    # 1. dump_quorum contract + curve sanity.  The silenced node never
+    # commits (peers gossip nothing to a peer whose round state they never
+    # hear), so it legitimately has zero records — and, never having
+    # analyzed a height, its snapshot still carries an empty node_id.
+    for node, snap in zip(nodes, quorums):
+        if node.node_id == silenced_id:
+            if snap["analysis_errors"]:
+                failures.append(
+                    f"{silenced_id}: {snap['analysis_errors']} analyzer "
+                    f"errors"
+                )
+            continue
+        _check_quorum_snapshot(snap, snap["node_id"] or node.node_id,
+                               failures)
+    limited = nodes[0].cs.quorumtrace.snapshot(limit=2)
+    if len(limited["records"]) != 2 or not limited["truncated"]:
+        failures.append(
+            f"limit=2 snapshot broke the truncation contract: "
+            f"{len(limited['records'])} records, "
+            f"truncated={limited['truncated']}"
+        )
+
+    # 2. cross-node fusion
+    report = quorum_report.build_report(
+        flights, quorums, n_validators=N_VALS
+    )
+    quorum_report.print_summary(report)
+    if not report["heights"]:
+        return _fail(["report fused zero heights"])
+
+    # the silenced validator must be absent from every quorum: no honest
+    # node ever saw its votes (and the silenced node itself never
+    # finalizes a height, so it contributes no curves either)
+    for h, entry in report["heights"].items():
+        for node, per_kind in entry["per_node"].items():
+            if node == silenced_id:
+                continue
+            for kind, info in per_kind.items():
+                if SILENCED in info["present"]:
+                    failures.append(
+                        f"h={h} {node} {kind}: silenced validator "
+                        f"{SILENCED} present in the quorum"
+                    )
+                if info["pivotal_validator"] == SILENCED:
+                    failures.append(
+                        f"h={h} {node} {kind}: silenced validator "
+                        f"{SILENCED} named pivotal"
+                    )
+    absent = quorum_report.absent_everywhere(report)
+    if SILENCED not in absent:
+        failures.append(
+            f"silenced validator {SILENCED} not in absent_everywhere "
+            f"{absent}"
+        )
+    for j in report["journeys"]:
+        if j["validator_index"] == SILENCED and j["arrivals"]:
+            failures.append(
+                f"silenced validator's {j['kind']} h={j['height']} "
+                f"arrived at {sorted(j['arrivals'])}"
+            )
+
+    # 3. gossip-efficiency ledger: waste must be real and finite
+    gossip = report["gossip"]
+    if not (0.0 < gossip["waste_ratio"] < math.inf):
+        failures.append(
+            f"waste ratio {gossip['waste_ratio']} not finite-positive "
+            f"(first={gossip['first_sightings']} "
+            f"dup={gossip['duplicates']})"
+        )
+    if not any(
+        link["latency_samples"] > 0 and link["latency_p99_s"] >= 0.0
+        for link in gossip["links"]
+    ):
+        failures.append("no link carried a propagation-latency sample")
+
+    # 4. exact journey <-> receiver-record reconciliation
+    n_checked = _reconcile_journeys(report, flights, failures)
+    if n_checked == 0:
+        failures.append("no journey arrivals to reconcile")
+    print(f"[quorum-smoke] {n_checked} journey arrivals reconcile exactly")
+
+    # 5. receive-seam counter invariant: first + dup == votes received
+    for node_id, total in votes_received.items():
+        first, dup = sighting_counts[node_id]
+        if int(first + dup) != int(total):
+            failures.append(
+                f"{node_id}: first({int(first)}) + dup({int(dup)}) != "
+                f"votes received ({total})"
+            )
+    if not any(d for _, d in sighting_counts.values()):
+        failures.append("duplicate counter never incremented on any node")
+
+    # 6. batching demonstrably on, with flush attribution in the records
+    if not any(feed_dispatches[n.node_id] for n in honest):
+        failures.append(
+            f"vote feed never dispatched: {feed_dispatches}"
+        )
+    if not any(
+        rec["flushes"]
+        for snap in quorums
+        if snap["node_id"] != silenced_id
+        for rec in snap["records"]
+    ):
+        failures.append("no quorum record carries VoteFeed flush records")
+
+    # 7. exposition: new families present and strictly lintable
+    for node_id, text in expositions.items():
+        for name in (
+            "tendermint_consensus_quorum_time_to_third_seconds",
+            "tendermint_consensus_quorum_time_to_two_thirds_seconds",
+            "tendermint_p2p_vote_first_sighting_total",
+            "tendermint_p2p_duplicate_votes_total",
+        ):
+            if f"# TYPE {name} " not in text:
+                failures.append(f"{node_id}: exposition missing {name}")
+        failures.extend(f"{node_id} metrics_lint: {e}"
+                        for e in lint_text(text))
+    vb_text = get_vote_batch_metrics().registry.expose_text()
+    if "tendermint_consensus_vote_batch_wait_seconds" not in vb_text:
+        failures.append(
+            "vote-batch exposition missing "
+            "tendermint_consensus_vote_batch_wait_seconds"
+        )
+    failures.extend(f"vote-batch metrics_lint: {e}"
+                    for e in lint_text(vb_text))
+
+    # 8. merged Chrome trace with flow arrows, strict validation.  The
+    # silenced node's track has no commit anchors (it never finalized a
+    # height), so the per-pid commit floor applies to the honest merge.
+    print("[quorum-smoke] merging flight dumps with flow arrows...")
+    honest_flights = [
+        d for d in flights if d.get("node_id") != silenced_id
+    ]
+    skews = trace_merge.compute_skews(honest_flights)
+    merged = trace_merge.merge(honest_flights, skews=skews)
+    failures.extend(flight_smoke.validate_chrome_trace(
+        merged, len(honest_flights),
+        min_commits_per_node=TARGET_HEIGHT - 1,
+    ))
+    _check_flow_events(merged, failures)
+
+    if failures:
+        return _fail(failures)
+
+    # 9. the regression-gate round: pooled honest-node time-to-2/3 tail
+    twos = [
+        curve["crossings"]["two_thirds"]["seconds"]
+        for snap in quorums
+        if snap["node_id"] != silenced_id
+        for rec in snap["records"]
+        for curve in rec["curves"].values()
+        if curve["crossings"]["two_thirds"] is not None
+    ]
+    parsed = {
+        "quorum_time_to_two_thirds_p99_seconds": round(
+            percentile(twos, 99), 6),
+        "quorum_time_to_two_thirds_p50_seconds": round(
+            percentile(twos, 50), 6),
+        "quorum_waste_ratio": round(gossip["waste_ratio"], 6),
+        "quorum_heights": len(report["heights"]),
+        "quorum_journeys": len(report["journeys"]),
+    }
+    _write_round(_ROOT, parsed)
+    print(f"[quorum-smoke] OK (p99 time-to-2/3 = "
+          f"{parsed['quorum_time_to_two_thirds_p99_seconds']}s, "
+          f"waste = {parsed['quorum_waste_ratio']})")
+    return 0
+
+
+def _fail(failures) -> int:
+    for f in failures:
+        print(f"[quorum-smoke] FAIL: {f}", file=sys.stderr)
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -265,6 +265,71 @@ def _flight_events(dump: dict, pid: int, skew_ns: int) -> List[dict]:
     return events
 
 
+def _quorumtrace():
+    """Lazy import of the vote-journey fuser (same sys.path fallback as
+    _critpath — see its docstring)."""
+    try:
+        from tendermint_tpu.libs import quorumtrace
+    except ImportError:
+        import os
+
+        sys.path.insert(
+            0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        )
+        from tendermint_tpu.libs import quorumtrace
+    return quorumtrace
+
+
+def _flow_events(dumps: List[dict], skews: List[int]) -> List[dict]:
+    """Chrome flow arrows from each vote's signer to each receiver: one
+    `s`/`f` pair per (journey, receiver), the `s` on the origin's track at
+    the corrected sign stamp and the `f` on the receiver's track at its
+    corrected first-sighting stamp.  Endpoints convert to µs FIRST and the
+    finish clamps to >= the start in µs space — the same float64-ulp
+    argument as the waterfall slices (a reversed arrow is a validator
+    error, not a rendering quirk)."""
+    qt = _quorumtrace()
+    pid_of = {
+        (d.get("node_id") or f"node{i}"): i for i, d in enumerate(dumps)
+    }
+    skew_map = {
+        (d.get("node_id") or f"node{i}"): skews[i]
+        for i, d in enumerate(dumps)
+    }
+    journeys = qt.build_journeys(dumps, skew_map)
+    events: List[dict] = []
+    for j in journeys:
+        origin = j["origin"]
+        if origin is None or j["signed_ns"] is None or origin not in pid_of:
+            continue  # no signer dump: nothing to draw the arrow from
+        origin_pid = pid_of[origin]
+        s_us = j["signed_ns"] / 1000.0  # skew already applied by the fuser
+        name = f"vote {j['kind']}"
+        for node, mark in sorted(j["arrivals"].items()):
+            if node == origin or node not in pid_of:
+                continue
+            flow_id = (
+                f"vote-{j['height']}-{j['kind']}-"
+                f"{j['validator_index']}-{pid_of[node]}"
+            )
+            f_us = max(mark.get("t_mono_ns", mark["t_ns"]) / 1000.0, s_us)
+            args = {
+                "height": j["height"],
+                "validator_index": j["validator_index"],
+            }
+            events.append({
+                "name": name, "cat": "flow", "ph": "s", "id": flow_id,
+                "pid": origin_pid, "tid": _FLIGHT_TID, "ts": s_us,
+                "args": args,
+            })
+            events.append({
+                "name": name, "cat": "flow", "ph": "f", "bp": "e",
+                "id": flow_id, "pid": pid_of[node], "tid": _FLIGHT_TID,
+                "ts": f_us, "args": dict(args, peer=mark.get("peer", "")),
+            })
+    return events
+
+
 def _trace_events(payload: dict, pid: int, skew_ns: int) -> List[dict]:
     """Retag one node's dump_trace events onto its merged track.  Trace ts
     are perf_counter µs; the dump-time {wall_ns, perf_ns} anchor converts
@@ -293,6 +358,8 @@ def merge(dumps: List[dict], traces: Optional[List[Optional[dict]]] = None,
         events.extend(_flight_events(dump, pid, skew))
         if traces is not None and pid < len(traces) and traces[pid]:
             events.extend(_trace_events(traces[pid], pid, skew))
+    # cross-node pass: vote-propagation arrows (signer -> each receiver)
+    events.extend(_flow_events(dumps, skews))
     return {
         "traceEvents": events,
         "displayTimeUnit": "ms",

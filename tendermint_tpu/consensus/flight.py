@@ -39,7 +39,15 @@ MAX_PEERS_PER_RECORD = 64  # per-peer vote attribution cap ("overflow" folds)
 
 
 def _vote_slot() -> dict:
-    return {"first": None, "last": None, "count": 0, "by_peer": {}}
+    return {
+        "first": None, "last": None, "count": 0, "by_peer": {},
+        # vote-journey stamps (libs/quorumtrace.py fuses these cross-node):
+        "signed": None,     # {t, round} — OUR vote leaving the signer
+        "first_send": {},   # validator_index -> {t, round, peer} first gossip
+        "arrivals": {},     # validator_index -> {t, round, peer} first sighting
+        "contrib": {},      # validator_index -> {t, round, power} quorum add
+        "dup_by_peer": {},  # peer -> duplicate votes received (gossip waste)
+    }
 
 
 class FlightRecorder:
@@ -156,9 +164,11 @@ class FlightRecorder:
                 rec["block_parts"] = {"t": t}
 
     def on_vote(self, height: int, round: int, kind: str, peer_id: str,
-                validator_index: int) -> None:
+                validator_index: int, power: int = 0) -> None:
         """One vote ADDED by the state machine (post-dedup/verify).  kind is
-        "prevote" | "precommit"; peer_id "" means our own/internal vote."""
+        "prevote" | "precommit"; peer_id "" means our own/internal vote.
+        ``power`` (the validator's voting power, when the caller knows it)
+        feeds the quorum-completion curve in libs/quorumtrace.py."""
         if not self.enabled:
             return
         t = self.now_ns()
@@ -171,10 +181,72 @@ class FlightRecorder:
                 slot["first"] = mark
             slot["last"] = mark
             slot["count"] += 1
+            contrib = slot["contrib"]
+            if validator_index >= 0 and validator_index not in contrib:
+                contrib[validator_index] = {
+                    "t": t, "round": round, "power": power
+                }
             by_peer = slot["by_peer"]
             if peer not in by_peer and len(by_peer) >= MAX_PEERS_PER_RECORD:
                 peer = "overflow"
             by_peer[peer] = by_peer.get(peer, 0) + 1
+
+    # vote-journey hooks (sign -> send -> arrival; add = contrib above) ------
+    def on_vote_signed(self, height: int, round: int, kind: str,
+                       validator_index: int) -> None:
+        """OUR vote the instant the privval signature lands (origin of the
+        journey).  First call wins — re-signs at later rounds keep the
+        original stamp for that kind."""
+        if not self.enabled:
+            return
+        t = self.now_ns()
+        with self._mtx:
+            slot = self._rec(height)[kind]
+            if slot["signed"] is None:
+                slot["signed"] = {
+                    "t": t, "round": round, "validator_index": validator_index
+                }
+
+    def on_vote_send(self, height: int, round: int, kind: str,
+                     validator_index: int, peer_id: str) -> None:
+        """First gossip send of validator_index's vote to ANY peer (the
+        reactor's pick_send_vote seam).  First send wins per validator;
+        beyond MAX_PEERS_PER_RECORD validators new entries are dropped."""
+        if not self.enabled:
+            return
+        t = self.now_ns()
+        with self._mtx:
+            sends = self._rec(height)[kind]["first_send"]
+            if validator_index in sends:
+                return
+            if len(sends) >= MAX_PEERS_PER_RECORD:
+                return
+            sends[validator_index] = {"t": t, "round": round, "peer": peer_id}
+
+    def on_vote_arrival(self, height: int, round: int, kind: str,
+                        peer_id: str, validator_index: int,
+                        duplicate: bool = False) -> None:
+        """A VoteMessage hitting the consensus reactor's receive seam —
+        BEFORE VoteSet dedup.  First sighting per validator stamps the
+        arrival; duplicates fold into the per-peer waste counter."""
+        if not self.enabled:
+            return
+        t = self.now_ns()
+        peer = peer_id or "local"
+        with self._mtx:
+            slot = self._rec(height)[kind]
+            if duplicate:
+                dup = slot["dup_by_peer"]
+                if peer not in dup and len(dup) >= MAX_PEERS_PER_RECORD:
+                    peer = "overflow"
+                dup[peer] = dup.get(peer, 0) + 1
+                return
+            arrivals = slot["arrivals"]
+            if validator_index in arrivals:
+                return
+            if len(arrivals) >= MAX_PEERS_PER_RECORD:
+                return
+            arrivals[validator_index] = {"t": t, "round": round, "peer": peer}
 
     def on_polka(self, height: int, round: int) -> None:
         if not self.enabled:

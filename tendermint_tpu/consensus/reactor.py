@@ -77,12 +77,15 @@ class PeerRoundState:
 class PeerState:
     """Thread-safe view of one peer's consensus knowledge (reactor.go:911)."""
 
-    def __init__(self, peer):
+    def __init__(self, peer, on_vote_send=None):
         self.peer = peer
         self._mtx = threading.Lock()
         self.prs = PeerRoundState()
         self.stats_votes = 0
         self.stats_block_parts = 0
+        # called with (vote, peer_id) after each successful gossip send —
+        # the reactor wires the flight recorder's first-send stamp here
+        self._on_vote_send = on_vote_send
 
     def get_round_state(self) -> PeerRoundState:
         with self._mtx:
@@ -150,6 +153,8 @@ class PeerState:
             return False
         if self.peer.send(VOTE_CHANNEL, encode_msg(VoteMessage(vote))):
             self.set_has_vote(vote)
+            if self._on_vote_send is not None:
+                self._on_vote_send(vote, self.peer.id)
             return True
         return False
 
@@ -297,6 +302,14 @@ class ConsensusReactor(Reactor):
         self._fs_mtx = threading.Lock()
         self._peer_states: Dict[str, PeerState] = {}
         self._ps_mtx = threading.Lock()
+        # first-sighting ledger at the receive seam, BEFORE VoteSet dedup:
+        # (height, round, type) -> {validator_index}.  Independent of the
+        # flight recorder's enable gate so the gossip-waste counters
+        # (tendermint_p2p_{vote_first_sighting,duplicate_votes}_total)
+        # always tick.  Pruned as the height advances.
+        self._vote_seen: Dict[tuple, set] = {}
+        self._vote_seen_max_h = 0
+        self._seen_mtx = threading.Lock()
 
     # -- Reactor interface ---------------------------------------------------------
     def get_channels(self):
@@ -356,7 +369,7 @@ class ConsensusReactor(Reactor):
     def add_peer(self, peer) -> None:
         if not self.is_running:
             return
-        ps = PeerState(peer)
+        ps = PeerState(peer, on_vote_send=self._note_vote_send)
         with self._ps_mtx:
             self._peer_states[peer.id] = ps
         for fn in (self._gossip_data_routine, self._gossip_votes_routine,
@@ -383,6 +396,46 @@ class ConsensusReactor(Reactor):
         the peer's shared state key, mempool/reactor.go:150)."""
         ps = self.peer_state(peer_id)
         return ps.height if ps is not None else None
+
+    # -- vote-journey attribution --------------------------------------------------
+    def _note_vote_send(self, vote, peer_id: str) -> None:
+        """PeerState gossip-send callback: stamp the FIRST outbound send of
+        each validator's vote (journey leg 2: sign -> first gossip)."""
+        self.cons.flight.on_vote_send(
+            vote.height, vote.round,
+            "prevote" if vote.vote_type == SignedMsgType.PREVOTE
+            else "precommit",
+            vote.validator_index, peer_id,
+        )
+
+    def _note_vote_arrival(self, vote, peer_id: str) -> None:
+        """Receive-seam first-sighting/duplicate split.  Every VoteMessage
+        increments EXACTLY one of the two counters, so their sum equals
+        total votes received — the reconciliation invariant quorum_smoke
+        checks.  Runs before VoteSet dedup burns a prevalidate."""
+        key = (vote.height, vote.round, int(vote.vote_type))
+        with self._seen_mtx:
+            if vote.height > self._vote_seen_max_h:
+                self._vote_seen_max_h = vote.height
+                floor = vote.height - 2  # keep h and the last-commit h-1
+                for k in [k for k in self._vote_seen if k[0] < floor]:
+                    del self._vote_seen[k]
+            seen = self._vote_seen.setdefault(key, set())
+            first = vote.validator_index not in seen
+            if first:
+                seen.add(vote.validator_index)
+        kind = (
+            "prevote" if vote.vote_type == SignedMsgType.PREVOTE
+            else "precommit"
+        )
+        self.cons.flight.on_vote_arrival(
+            vote.height, vote.round, kind, peer_id, vote.validator_index,
+            duplicate=not first,
+        )
+        if self.cons.metrics is not None:
+            self.cons.metrics.record_vote_sighting(
+                peer_id, VOTE_CHANNEL, first=first
+            )
 
     # -- inbound -------------------------------------------------------------------
     def receive(self, chan_id: int, peer, msg_bytes: bytes) -> None:
@@ -429,6 +482,7 @@ class ConsensusReactor(Reactor):
             if self.fast_sync:
                 return
             if isinstance(msg, VoteMessage):
+                self._note_vote_arrival(msg.vote, peer.id)
                 with self.cons._mtx:
                     height = self.cons.rs.height
                     val_size = self.cons.rs.validators.size

@@ -38,6 +38,7 @@ from tendermint_tpu.consensus.ticker import TimeoutTicker
 from tendermint_tpu.consensus.wal import NilWAL, WAL
 from tendermint_tpu.libs import trace
 from tendermint_tpu.libs.critpath import CritPath
+from tendermint_tpu.libs.quorumtrace import QuorumTrace
 from tendermint_tpu.libs.events import EventSwitch
 from tendermint_tpu.libs.service import BaseService
 from tendermint_tpu.types import (
@@ -114,6 +115,9 @@ class ConsensusState(BaseService):
         # commit-latency waterfall analyzer; piggybacks on the flight
         # recorder's enable gate (no stamps -> nothing to analyze)
         self.critpath = CritPath(metrics=metrics)
+        # quorum-formation analyzer: per-height time-to-1/3/2/3 curves and
+        # gossip-waste ledger off the same flight stamps (libs/quorumtrace)
+        self.quorumtrace = QuorumTrace(metrics=metrics)
         # wall-clock source for proposal/vote timestamps and latency
         # accounting; the sim harness swaps in a skewed/frozen clock
         self.now_ns: Callable[[], int] = time.time_ns
@@ -883,6 +887,12 @@ class ConsensusState(BaseService):
         # the height's lifecycle is complete — fuse its flight stamps, WAL
         # costs, and verify-dispatch ledger into one waterfall record
         self.critpath.on_height_complete(height, self.flight, wal=self.wal)
+        # quorum curve needs the committed height's valset (rs advances only
+        # in update_to_state below) and the batch-flush ledger if batching
+        self.quorumtrace.on_height_complete(
+            height, self.flight,
+            validators=rs.validators, vote_feed=self._vote_feed,
+        )
 
         fail.fail_point()
 
@@ -1116,6 +1126,16 @@ class ConsensusState(BaseService):
             )
             self.metrics.vote_arrival_latency.observe(lat, (kind,))
 
+    def _vote_power(self, vote: Vote) -> int:
+        """The voter's power in the CURRENT valset (0 when unknown — e.g. a
+        last-commit straggler after a valset change).  Feeds the flight
+        recorder's quorum-contribution stamps."""
+        try:
+            _, val = self.rs.validators.get_by_index(vote.validator_index)
+            return val.voting_power if val is not None else 0
+        except Exception:
+            return 0
+
     def _add_vote(self, vote: Vote, peer_id: str,
                   verified: bool = False) -> bool:
         rs = self.rs
@@ -1138,7 +1158,8 @@ class ConsensusState(BaseService):
                 return False
             self._observe_vote_latency(vote)
             self.flight.on_vote(
-                vote.height, vote.round, "precommit", peer_id, vote.validator_index
+                vote.height, vote.round, "precommit", peer_id,
+                vote.validator_index,
             )
             self._publish_vote_event(vote)
             if self.config.skip_timeout_commit and rs.last_commit.has_all():
@@ -1159,6 +1180,7 @@ class ConsensusState(BaseService):
             "prevote" if vote.vote_type == SignedMsgType.PREVOTE else "precommit",
             peer_id,
             vote.validator_index,
+            power=self._vote_power(vote),
         )
         self._publish_vote_event(vote)
 
@@ -1280,5 +1302,12 @@ class ConsensusState(BaseService):
                 self.logger.error("error signing vote h=%d r=%d: %s",
                                   self.rs.height, self.rs.round, e)
             return None
+        # journey origin: OUR vote exists the instant the signature lands,
+        # before it enters the internal queue / gossip
+        self.flight.on_vote_signed(
+            vote.height, vote.round,
+            "prevote" if t == SignedMsgType.PREVOTE else "precommit",
+            vote.validator_index,
+        )
         self.send_internal(VoteMessage(vote))
         return vote

@@ -626,6 +626,13 @@ class VoteBatchMetrics:
             "Vote micro-batcher flushes by trigger (deadline|quorum|close)",
             label_names=("reason",),
         )
+        self.batch_wait = r.histogram(
+            "consensus_vote_batch_wait_seconds",
+            "Queue wait a vote spent parked in the micro-batcher between "
+            "ticket submit and flush (batching-added latency, separable "
+            "from network propagation in the quorum reports)",
+            buckets=[b / 100 for b in _DEFAULT_BUCKETS],
+        )
 
     def record_flush(self, reason: str, rows: int, lanes: int,
                      occupancy: float) -> None:
@@ -634,6 +641,11 @@ class VoteBatchMetrics:
         self.batch_lanes.observe(float(lanes))
         self.lane_occupancy.observe(float(occupancy))
         self.flushes.add(1.0, (reason,))
+
+    def record_wait(self, seconds: float) -> None:
+        """One ticket's submit->flush queue wait."""
+        if seconds >= 0.0:
+            self.batch_wait.observe(seconds)
 
 
 _vote_batch_mtx = threading.Lock()
@@ -762,6 +774,22 @@ class NodeMetrics:
             buckets=[b / 10 for b in _DEFAULT_BUCKETS],
             label_names=("phase",),
         )
+        # quorum formation (libs/quorumtrace.py): wall seconds from round
+        # entry until arriving voting power crossed 1/3 and 2/3 of total
+        self.quorum_time_to_third = r.histogram(
+            "consensus_quorum_time_to_third_seconds",
+            "Per-height wall seconds from round entry until arriving votes "
+            "crossed 1/3 of total voting power",
+            buckets=[b / 10 for b in _DEFAULT_BUCKETS],
+            label_names=("type",),
+        )
+        self.quorum_time_to_two_thirds = r.histogram(
+            "consensus_quorum_time_to_two_thirds_seconds",
+            "Per-height wall seconds from round entry until arriving votes "
+            "crossed 2/3 of total voting power (quorum)",
+            buckets=[b / 10 for b in _DEFAULT_BUCKETS],
+            label_names=("type",),
+        )
         # liveness watchdog (libs/watchdog.py)
         self.stalls = r.counter(
             "consensus_stalls_total",
@@ -805,6 +833,21 @@ class NodeMetrics:
             "p2p_messages_sent_total",
             "Messages queued toward peers by channel",
             label_names=("chID",),
+        )
+        # vote-gossip efficiency at the consensus reactor receive seam
+        # (BEFORE VoteSet dedup): every VoteMessage increments exactly one
+        # of these two, so their sum is total votes received
+        self.vote_first_sighting = r.counter(
+            "p2p_vote_first_sighting_total",
+            "Votes received that were the node's first sighting of that "
+            "(height, round, type, validator) vote, by gossiping peer",
+            label_names=("peer_id", "chID"),
+        )
+        self.duplicate_votes = r.counter(
+            "p2p_duplicate_votes_total",
+            "Votes received that the node had already seen (gossip "
+            "amplification waste), by gossiping peer",
+            label_names=("peer_id", "chID"),
         )
         # mempool
         self.mempool_size = r.gauge("mempool_size", "Unconfirmed txs in the mempool")
@@ -936,6 +979,17 @@ class NodeMetrics:
         self.peer_pending_send_bytes.set(float(pending),
                                          (self._peer_label(peer_id),))
 
+    def record_vote_sighting(self, peer_id: str, chan_id: int,
+                             first: bool) -> None:
+        """One VoteMessage at the reactor receive seam: first sighting or
+        duplicate (same 64-peer label fold as the traffic counters)."""
+        pid = self._peer_label(peer_id)
+        ch = f"{chan_id:#x}"
+        if first:
+            self.vote_first_sighting.add(1.0, (pid, ch))
+        else:
+            self.duplicate_votes.add(1.0, (pid, ch))
+
     def forget_peer(self, peer_id: str) -> None:
         """Drop every per-peer series for a disconnected peer so label
         cardinality tracks the live peer set, not its history."""
@@ -944,3 +998,5 @@ class NodeMetrics:
         self.peer_send_bytes.remove_matching("peer_id", peer_id)
         self.peer_receive_bytes.remove_matching("peer_id", peer_id)
         self.peer_pending_send_bytes.remove_matching("peer_id", peer_id)
+        self.vote_first_sighting.remove_matching("peer_id", peer_id)
+        self.duplicate_votes.remove_matching("peer_id", peer_id)

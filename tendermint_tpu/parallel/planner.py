@@ -1396,14 +1396,18 @@ class VoteVerdict:
 
 class VoteTicket:
     """Handle for one submitted vote; `result()` blocks until the feed's
-    worker flushes the batch the vote rode in."""
+    worker flushes the batch the vote rode in.  `submitted_ns`/`flushed_ns`
+    (wall clock) bound the queue wait the micro-batcher added — the
+    batching-vs-network split in the quorum reports."""
 
-    __slots__ = ("_ev", "_verdict", "_err")
+    __slots__ = ("_ev", "_verdict", "_err", "submitted_ns", "flushed_ns")
 
     def __init__(self):
         self._ev = threading.Event()
         self._verdict: Optional[VoteVerdict] = None
         self._err: Optional[BaseException] = None
+        self.submitted_ns = 0
+        self.flushed_ns = 0
 
     def _resolve(self, verdict=None, err=None) -> None:
         self._verdict = verdict
@@ -1440,10 +1444,13 @@ class VoteFeed:
     out the window.  Flushes record their trigger (deadline|quorum|close)
     into `tendermint_consensus_vote_batch_flush_total`."""
 
+    FLUSH_RECORD_CAPACITY = 256  # flush-attribution ring (quorumtrace join)
+
     def __init__(self, mesh=None, verifier=None,
                  use_device: Optional[bool] = None, window_s: float = 0.002,
                  max_rows: int = 64,
-                 profile_kind: str = "consensus.vote_batch", on_flush=None):
+                 profile_kind: str = "consensus.vote_batch", on_flush=None,
+                 now_ns=None):
         self.mesh = mesh
         if verifier is None:
             # live-vote flushes default to the RLC host backend: one
@@ -1468,6 +1475,10 @@ class VoteFeed:
         self.votes_in = 0
         self.rows_out = 0
         self.flushes: dict = {"deadline": 0, "quorum": 0, "close": 0}
+        # wall-clock source for ticket submit/flush stamps; injectable so
+        # the sim harness can share a node's skewed clock (stamps must live
+        # in the same timeline as the node's flight records)
+        self.now_ns = now_ns if now_ns is not None else time.time_ns
         self._cond = threading.Condition()
         # (group_key, pub, msg, sig, power, total, ticket)
         self._pending: List[tuple] = []
@@ -1475,6 +1486,11 @@ class VoteFeed:
         self._urgent = False
         self._closed = False
         self._thread: Optional[threading.Thread] = None
+        # bounded ledger of recent flushes for batch-flush attribution
+        # (scripts/quorum_report.py joins these against vote journeys by
+        # group key); oldest entries fall off the ring
+        self._flush_recs: List[dict] = []
+        self._flush_recs_dropped = 0
 
     def submit(
         self,
@@ -1495,6 +1511,7 @@ class VoteFeed:
                 raise RuntimeError("vote feed is closed")
             if not self._pending:
                 self._deadline = time.monotonic() + self.window_s
+            ticket.submitted_ns = self.now_ns()
             self._pending.append(
                 (group_key, pub, bytes(msg), bytes(sig), int(power),
                  int(total), ticket)
@@ -1530,6 +1547,17 @@ class VoteFeed:
         if t is not None:
             t.join(timeout)
 
+    def flush_records(self) -> dict:
+        """Copy of the recent-flush attribution ledger: per flush the
+        trigger, shape, covered (height, round, type) groups, window-open
+        and flush wall stamps, and the worst/mean ticket queue wait."""
+        with self._cond:
+            return {
+                "capacity": self.FLUSH_RECORD_CAPACITY,
+                "dropped": self._flush_recs_dropped,
+                "records": [dict(r) for r in self._flush_recs],
+            }
+
     def _worker(self) -> None:
         while True:
             with self._cond:
@@ -1561,6 +1589,18 @@ class VoteFeed:
             self._flush(batch, reason)
 
     def _flush(self, batch: List[tuple], reason: str) -> None:
+        # stamp the batch leaving the feed BEFORE the dispatch: queue wait
+        # is submit->flush, not submit->verdict (dispatch cost is already
+        # measured by the profiler/verify families)
+        t_flush = self.now_ns()
+        waits: List[float] = []
+        for item in batch:
+            ticket = item[6]
+            ticket.flushed_ns = t_flush
+            if ticket.submitted_ns:
+                waits.append(
+                    max(0.0, (t_flush - ticket.submitted_ns) / 1e9)
+                )
         # one lane row per vote-set group, in first-seen order; votes keep
         # their lane position so verdicts map back per ticket
         rows: List[tuple] = []  # (vrow, prow, total, tickets)
@@ -1574,6 +1614,34 @@ class VoteFeed:
             row[0].append((pub, msg, sig))
             row[1].append(power)
             row[3].append(ticket)
+        rec = {
+            "reason": reason,
+            "votes": len(batch),
+            "rows": len(rows),
+            "groups": [
+                list(gk) if isinstance(gk, tuple) else gk for gk in by_key
+            ],
+            "t_open_ns": min(
+                (it[6].submitted_ns for it in batch if it[6].submitted_ns),
+                default=t_flush,
+            ),
+            "t_flush_ns": t_flush,
+            "wait_max_s": max(waits) if waits else 0.0,
+            "wait_mean_s": (sum(waits) / len(waits)) if waits else 0.0,
+        }
+        with self._cond:
+            self._flush_recs.append(rec)
+            if len(self._flush_recs) > self.FLUSH_RECORD_CAPACITY:
+                del self._flush_recs[0]
+                self._flush_recs_dropped += 1
+        try:
+            from tendermint_tpu.libs.metrics import get_vote_batch_metrics
+
+            vm = get_vote_batch_metrics()
+            for w in waits:
+                vm.record_wait(w)
+        except Exception:
+            pass
         chunks = [
             rows[i: i + self.max_rows]
             for i in range(0, len(rows), self.max_rows)

@@ -160,6 +160,17 @@ class HTTPClient:
             **({"capacity": capacity} if capacity is not None else {}),
         )
 
+    def dump_quorum(self, limit: Optional[int] = None) -> dict:
+        return self.call(
+            "dump_quorum", **({"limit": limit} if limit is not None else {})
+        )
+
+    def quorum_reset(self, capacity: Optional[int] = None) -> dict:
+        return self.call(
+            "quorum_reset",
+            **({"capacity": capacity} if capacity is not None else {}),
+        )
+
     def dump_device_health(self) -> dict:
         return self.call("dump_device_health")
 

@@ -793,6 +793,39 @@ class RPCEnv:
             out["node_id"] = cs.flight.node_id
         return out
 
+    def dump_quorum(self, limit=None) -> dict:
+        """Snapshot the quorum-formation analyzer: per-height completion
+        curves (time-to-1/3/2/3 with the pivotal validator named),
+        gossip first-sighting/duplicate counts, and batch-flush
+        attribution (libs/quorumtrace.py).  limit=N keeps the newest N
+        height records.  Gated like dump_flight — per-peer vote
+        attribution leaks topology."""
+        self._require_unsafe()
+        if limit is not None:
+            limit = int(limit)
+            if limit < 0:
+                raise RPCError(-32602, "limit must be >= 0")
+        cs = self.node.consensus_state
+        out = cs.quorumtrace.snapshot(limit)
+        # curves only accrue while the flight recorder stamps journeys
+        out["flight_enabled"] = cs.flight.enabled
+        if not out["node_id"]:
+            out["node_id"] = cs.flight.node_id
+        return out
+
+    def quorum_reset(self, capacity=None) -> dict:
+        """Clear the quorum-formation record ring and its rolling
+        time-to-quorum percentile windows; optionally resize the ring
+        (capacity=N)."""
+        self._require_unsafe()
+        qt = self.node.consensus_state.quorumtrace
+        if capacity is not None:
+            capacity = int(capacity)
+            if capacity < 1:
+                raise RPCError(-32602, "capacity must be >= 1")
+        qt.reset(capacity)
+        return {"capacity": qt.capacity}
+
     def critpath_reset(self, capacity=None) -> dict:
         """Clear the critical-path waterfall ring and its rolling phase
         percentile windows; optionally resize the ring (capacity=N)."""

@@ -140,6 +140,9 @@ class SimNode:
             self.vote_feed = VoteFeed(
                 window_s=cfg.verify.vote_batch_window_ms / 1000.0,
                 max_rows=cfg.verify.vote_batch_rows,
+                # ticket stamps share the node's (possibly skewed) clock so
+                # flush spans fuse onto the node's flight-record timeline
+                now_ns=self.clock,
             )
             self.cs.set_vote_feed(self.vote_feed)
         # [mempool] tx_batch_window_ms > 0: batched CheckTx signature

@@ -73,6 +73,7 @@ class ScenarioResult:
     commit_rounds: List[Dict[int, int]]  # per node: height -> commit round
     flight_dumps: List[dict]
     critpath_dumps: List[dict]  # per node: cs.critpath.snapshot()
+    quorum_dumps: List[dict]  # per node: cs.quorumtrace.snapshot()
     fault_summary: dict
     stall_reports: List[dict]
     marks: Dict[str, dict]
@@ -244,6 +245,7 @@ def run_scenario(scenario: Scenario, seed: Optional[int] = None) -> ScenarioResu
     commit_rounds: List[Dict[int, int]] = []
     flight_dumps: List[dict] = []
     critpath_dumps: List[dict] = []
+    quorum_dumps: List[dict] = []
     stall_reports: List[dict] = []
     summary: dict = {}
     started = time.monotonic()
@@ -299,6 +301,7 @@ def run_scenario(scenario: Scenario, seed: Optional[int] = None) -> ScenarioResu
         commit_rounds = [n.commit_rounds() for n in nodes]
         flight_dumps = [n.cs.flight.snapshot() for n in nodes]
         critpath_dumps = [n.cs.critpath.snapshot() for n in nodes]
+        quorum_dumps = [n.cs.quorumtrace.snapshot() for n in nodes]
         stall_reports = [
             n.watchdog.report() for n in nodes
             if n.watchdog is not None and n.watchdog.report() is not None
@@ -327,6 +330,7 @@ def run_scenario(scenario: Scenario, seed: Optional[int] = None) -> ScenarioResu
         commit_rounds=commit_rounds,
         flight_dumps=flight_dumps,
         critpath_dumps=critpath_dumps,
+        quorum_dumps=quorum_dumps,
         fault_summary=summary,
         stall_reports=stall_reports,
         marks=run.marks,

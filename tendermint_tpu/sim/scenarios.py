@@ -44,6 +44,11 @@ crash_restart         one node killed mid-height, rebuilt from its stores +
                       WAL ⇒ WAL messages replay, the ABCI handshake
                       re-applies committed blocks into the fresh app, and
                       the node catches back up to the chain
+quorum_observatory    ±2s skews + seeded storm + one frozen-then-resumed
+                      clock ⇒ fused vote journeys are monotone after
+                      commit-anchor correction and each node's pivotal-
+                      validator naming re-derives bit-identically from its
+                      own dump
 ====================  =====================================================
 """
 
@@ -980,6 +985,185 @@ def crash_restart() -> Scenario:
     )
 
 
+def quorum_observatory() -> Scenario:
+    """The observability stack under its designed-for conditions: ±2s
+    wall-clock skews, a seeded gossip storm (duplicates + reorder feed the
+    waste ledger), and one node's clock frozen mid-run then resumed (the
+    worst distortion commit-anchor math must survive).  Claims: every
+    fused vote journey presents a monotone sign -> send -> arrival
+    timeline after anchor correction (with the raw stamps of unfrozen
+    nodes landing within a small residual of the signer's corrected
+    stamp — i.e. the injected ±2s really was measured back out), the
+    freeze demonstrably distorted stamps (some journey got clamped), and
+    every live quorum record's pivotal-validator naming re-derives
+    bit-identically from the node's own flight dump — identification is a
+    deterministic pure function of the stamps, not of analysis timing."""
+
+    FROZEN = 2  # index into SKEWS_NS: the -1.5s node also gets frozen
+    storm_policy = dict(delay_s=0.002, jitter_s=0.008, drop=0.05,
+                        duplicate=0.15, reorder=0.15, reorder_extra_s=0.03)
+
+    def drive(run: ScenarioRun) -> List[str]:
+        import time as _time
+
+        failures = []
+        if not run.wait_height(2, 45.0):
+            return [f"never warmed up: {run.heights()}"]
+        clk = run.nodes[FROZEN].clock
+        # freeze at the current instant: now_ns() keeps returning
+        # frozen + skew, so the node's stamps stop advancing while the
+        # chain (driven by real-time timers, not wall stamps) keeps going
+        clk.freeze(_time.time_ns())
+        run.mark("frozen")
+        h = max(run.heights())
+        if not run.wait_height(h + 2, 60.0):
+            failures.append(
+                f"no progress while node {FROZEN}'s clock was frozen: "
+                f"{run.heights()}"
+            )
+        clk.freeze(0)  # resume
+        run.mark("resumed")
+        h2 = max(run.heights())
+        if not run.wait_height(h2 + 2, 60.0):
+            failures.append(
+                f"no progress after clock resume: {run.heights()}"
+            )
+        return failures
+
+    def check(run: ScenarioRun) -> List[str]:
+        import importlib.util
+        import os
+
+        from tendermint_tpu.libs import quorumtrace as qt
+
+        spec = importlib.util.spec_from_file_location(
+            "trace_merge",
+            os.path.join(os.path.dirname(os.path.dirname(
+                os.path.dirname(os.path.abspath(__file__)))),
+                "scripts", "trace_merge.py"),
+        )
+        tm = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(tm)
+
+        failures = []
+        frozen_id = run.nodes[FROZEN].node_id
+        dumps = [n.cs.flight.snapshot() for n in run.nodes]
+        skews = tm.compute_skews(dumps)
+        # anchor recovery must still measure the injected skews out of the
+        # UNFROZEN nodes; the frozen node's freeze-window anchors are
+        # legitimately bogus (the median absorbs them, but only within
+        # whatever share of heights the freeze covered — don't gate on it)
+        for i, skew in enumerate(skews):
+            if i == FROZEN:
+                continue
+            expected = SKEWS_NS[0] - SKEWS_NS[i]
+            err_s = abs(skew - expected) / 1e9
+            if err_s > 0.5:
+                failures.append(
+                    f"node {i}: recovered skew {skew / 1e9:+.3f}s vs "
+                    f"injected {expected / 1e9:+.3f}s (err {err_s:.3f}s)"
+                )
+        skew_map = {
+            (d.get("node_id") or f"node{i}"): skews[i]
+            for i, d in enumerate(dumps)
+        }
+        journeys = qt.build_journeys(dumps, skew_map)
+        with_arrivals = [
+            j for j in journeys
+            if j["signed_ns"] is not None and j["arrivals"]
+        ]
+        if len(with_arrivals) < 4:
+            failures.append(
+                f"only {len(with_arrivals)} journeys fused with both a "
+                f"sign stamp and arrivals"
+            )
+        clamped_on_frozen = False
+        for j in with_arrivals:
+            floor = j["signed_ns"]
+            send = j["first_send"]
+            if send is not None:
+                if send["t_mono_ns"] < floor:
+                    failures.append(
+                        f"h={j['height']} {j['kind']} vi="
+                        f"{j['validator_index']}: first_send precedes "
+                        f"sign in the monotone view"
+                    )
+                floor = send["t_mono_ns"]
+            for node, mark in j["arrivals"].items():
+                if mark["t_mono_ns"] < floor:
+                    failures.append(
+                        f"h={j['height']} {j['kind']} vi="
+                        f"{j['validator_index']}: arrival at {node} "
+                        f"precedes its upstream leg in the monotone view"
+                    )
+                # unfrozen raw stamps must sit within a small residual of
+                # the signer's corrected stamp: uncorrected, the -1.5s /
+                # +2s skews would invert these legs by whole seconds
+                if (node != frozen_id and j["origin"] != frozen_id
+                        and mark["t_ns"] < j["signed_ns"] - 350_000_000):
+                    failures.append(
+                        f"h={j['height']} {j['kind']} vi="
+                        f"{j['validator_index']}: arrival at {node} "
+                        f"{(mark['t_ns'] - j['signed_ns']) / 1e9:+.3f}s "
+                        f"before signing — skew not corrected out"
+                    )
+                if j["clamped"] and (node == frozen_id
+                                     or j["origin"] == frozen_id):
+                    clamped_on_frozen = True
+        if not clamped_on_frozen:
+            failures.append(
+                "freeze never distorted a journey (no clamped stamp "
+                "touching the frozen node) — the scenario lost its bite"
+            )
+        # pivotal-validator determinism: every live record's curves must
+        # re-derive bit-identically from the node's own flight record —
+        # the naming is a pure function of the dump, so any consumer
+        # (report, RPC, re-analysis) reproduces it exactly
+        named = 0
+        for node in run.nodes:
+            for rec in node.cs.quorumtrace.records():
+                frec = node.cs.flight.peek(rec["height"])
+                if frec is None:
+                    continue  # ring evicted it; nothing to re-derive
+                for kind, curve in rec["curves"].items():
+                    redo = qt.completion_curve(
+                        frec, kind, curve["total_power"]
+                    )
+                    if redo is None or (
+                        redo["pivotal_validator"]
+                        != curve["pivotal_validator"]
+                        or redo["crossings"] != curve["crossings"]
+                    ):
+                        failures.append(
+                            f"{node.node_id}: h={rec['height']} {kind} "
+                            f"re-derived pivotal "
+                            f"{redo and redo['pivotal_validator']} != "
+                            f"recorded {curve['pivotal_validator']}"
+                        )
+                    if curve["pivotal_validator"] is not None:
+                        named += 1
+        if named == 0:
+            failures.append("no height named a pivotal validator")
+        return failures
+
+    return Scenario(
+        name="quorum_observatory",
+        description="±2s skews + seeded storm + one frozen-then-resumed "
+                    "clock: fused vote journeys stay monotone after "
+                    "commit-anchor correction and pivotal-validator "
+                    "naming re-derives bit-identically from the dumps",
+        seed=13,
+        timeout_s=180.0,
+        config_factory=_skew_config,
+        clock_factory=lambda i: SimClock(skew_ns=SKEWS_NS[i]),
+        drive=drive,
+        check=check,
+        ops=[FaultOp(at_s=0.0, op="policy",
+                     kwargs={"src": None, "dst": None,
+                             "policy": storm_policy})],
+    )
+
+
 SCENARIOS: Dict[str, Callable[[], Scenario]] = {
     "baseline_determinism": baseline_determinism,
     "partition_heal": partition_heal,
@@ -994,4 +1178,5 @@ SCENARIOS: Dict[str, Callable[[], Scenario]] = {
     "signed_flood": signed_flood,
     "device_flap": device_flap,
     "crash_restart": crash_restart,
+    "quorum_observatory": quorum_observatory,
 }

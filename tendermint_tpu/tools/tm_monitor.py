@@ -107,6 +107,18 @@ def _verify_path(metrics: Dict[str, float]) -> str:
     return seen.pop()
 
 
+def _quorum_column(metrics: Dict[str, float]) -> str:
+    """Mean time-to-strict-2/3 quorum across vote kinds, from the
+    quorum_time_to_two_thirds_seconds family's _sum/_count; "-" when the
+    quorum observatory has no samples (flight recorder off)."""
+    fam = "tendermint_consensus_quorum_time_to_two_thirds_seconds"
+    total = _sum_family(metrics, fam + "_sum")
+    count = _sum_family(metrics, fam + "_count")
+    if count <= 0:
+        return "-"
+    return f"{1e3 * total / count:.0f}ms"
+
+
 def _crit_column(metrics: Dict[str, float]) -> str:
     """Dominant commit-path phase from the height_phase_seconds family:
     `phase avg_ms` where avg is the per-height mean of the phase with the
@@ -157,6 +169,9 @@ class NodeMonitor:
         # dominant commit-path phase + its mean per-height cost, or "-"
         # when the critpath analyzer has no samples (flight recorder off)
         self.crit = "-"
+        # quorum column (tendermint_consensus_quorum_time_to_two_thirds_
+        # seconds): mean time-to-strict-2/3 across vote kinds, or "-"
+        self.quorum = "-"
         self._last_block_at: Optional[float] = None
         self._started = time.monotonic()
         self._online_time = 0.0
@@ -222,6 +237,7 @@ class NodeMonitor:
             _sum_family(m, "tendermint_verify_device_fallback_total")
         )
         self.crit = _crit_column(m)
+        self.quorum = _quorum_column(m)
 
     def _connect_ws(self) -> None:
         try:
@@ -277,6 +293,7 @@ class NodeMonitor:
             "device_state": self.device_state,
             "device_fallbacks": self.device_fallbacks,
             "crit": self.crit,
+            "quorum": self.quorum,
             "uptime_pct": self.uptime_pct,
         }
 
@@ -365,6 +382,7 @@ def main(argv=None) -> int:
                       f"height {snap['max_height']})")
                 print(f"{'MONIKER':<16}{'HEIGHT':>8}{'INTERVAL':>10}"
                       f"{'VERIFY':>14}{'DEVICE':>10}{'CRIT':>15}"
+                      f"{'QUORUM':>8}"
                       f"{'TRAFFIC':>10}{'STALL':>9}{'UPTIME':>8}  ADDR")
                 for n in snap["nodes"]:
                     if n["online"]:
@@ -387,6 +405,7 @@ def main(argv=None) -> int:
                         f"{_fmt_verify(n['verify_ms'], n.get('verify_path', '-')):>14}"
                         f"{_fmt_device(n['device_state'], n['device_fallbacks']):>10}"
                         f"{n['crit']:>15}"
+                        f"{n.get('quorum', '-'):>8}"
                         f"{_fmt_bytes(n['traffic_bytes']):>10}"
                         f"{stall:>9}"
                         f"{n['uptime_pct']:>7}%  "

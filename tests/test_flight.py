@@ -1,7 +1,8 @@
 """Flight recorder + liveness watchdog + cross-node merge.
 
 Unit tier: FlightRecorder ring semantics (disabled no-op, eviction,
-limit/truncated export, per-peer attribution caps), deterministic
+limit/truncated export, per-peer attribution caps), the vote-journey
+stamps (sign/send/arrival first-wins, duplicate folding), deterministic
 LivenessWatchdog sampling via check(now=...), pubsub slow-subscriber drop
 accounting, and trace_merge skew math over synthetic dumps.
 
@@ -124,6 +125,72 @@ class TestFlightRecorder:
         assert len(by_peer) == MAX_PEERS_PER_RECORD + 1
         assert by_peer["overflow"] == 6
         assert rec["prevote"]["count"] == MAX_PEERS_PER_RECORD + 6
+
+    def test_vote_signed_first_wins(self):
+        fr = FlightRecorder(enabled=True)
+        fr.on_vote_signed(1, 0, "prevote", 2)
+        fr.on_vote_signed(1, 3, "prevote", 2)  # re-sign at a later round
+        (rec,) = fr.records()
+        assert rec["prevote"]["signed"]["round"] == 0
+        assert rec["prevote"]["signed"]["validator_index"] == 2
+        assert rec["precommit"]["signed"] is None
+
+    def test_vote_send_first_per_validator_and_cap(self):
+        fr = FlightRecorder(enabled=True)
+        fr.on_vote_send(1, 0, "prevote", 1, "peerA")
+        fr.on_vote_send(1, 0, "prevote", 1, "peerB")  # later send ignored
+        (rec,) = fr.records()
+        assert rec["prevote"]["first_send"][1]["peer"] == "peerA"
+        for vi in range(2, MAX_PEERS_PER_RECORD + 1):
+            fr.on_vote_send(1, 0, "prevote", vi, "p")
+        fr.on_vote_send(1, 0, "prevote", 999, "p")  # over the cap: dropped
+        (rec,) = fr.records()
+        sends = rec["prevote"]["first_send"]
+        assert len(sends) == MAX_PEERS_PER_RECORD and 999 not in sends
+
+    def test_vote_arrival_first_wins_and_dup_folds(self):
+        fr = FlightRecorder(enabled=True)
+        fr.on_vote_arrival(1, 0, "precommit", "peerA", 3)
+        fr.on_vote_arrival(1, 0, "precommit", "peerB", 3, duplicate=True)
+        fr.on_vote_arrival(1, 0, "precommit", "peerB", 3, duplicate=True)
+        fr.on_vote_arrival(1, 0, "precommit", "peerA", 5, duplicate=True)
+        (rec,) = fr.records()
+        slot = rec["precommit"]
+        assert set(slot["arrivals"]) == {3}
+        assert slot["arrivals"][3]["peer"] == "peerA"
+        assert slot["dup_by_peer"] == {"peerB": 2, "peerA": 1}
+
+    def test_vote_arrival_caps_and_dup_overflow(self):
+        fr = FlightRecorder(enabled=True)
+        for vi in range(MAX_PEERS_PER_RECORD):
+            fr.on_vote_arrival(1, 0, "prevote", f"peer{vi}", vi)
+        fr.on_vote_arrival(1, 0, "prevote", "late", 999)  # dropped
+        for i in range(MAX_PEERS_PER_RECORD + 4):
+            fr.on_vote_arrival(1, 0, "prevote", f"dup{i}", 0, duplicate=True)
+        (rec,) = fr.records()
+        slot = rec["prevote"]
+        assert len(slot["arrivals"]) == MAX_PEERS_PER_RECORD
+        assert 999 not in slot["arrivals"]
+        assert slot["dup_by_peer"]["overflow"] == 4
+        assert len(slot["dup_by_peer"]) == MAX_PEERS_PER_RECORD + 1
+
+    def test_disabled_vote_journey_hooks_are_noops(self):
+        fr = FlightRecorder()
+        fr.on_vote_signed(1, 0, "prevote", 0)
+        fr.on_vote_send(1, 0, "prevote", 0, "p")
+        fr.on_vote_arrival(1, 0, "prevote", "p", 0)
+        assert len(fr) == 0
+
+    def test_journey_stamps_survive_snapshot_copy(self):
+        fr = FlightRecorder(enabled=True)
+        fr.on_vote_signed(1, 0, "prevote", 0)
+        fr.on_vote_arrival(1, 0, "prevote", "peerA", 1)
+        snap = fr.snapshot()
+        snap["records"][0]["prevote"]["arrivals"][1]["peer"] = "mutated"
+        snap["records"][0]["prevote"]["signed"]["t"] = -1
+        (rec,) = fr.records()  # the recorder's copy is unaffected
+        assert rec["prevote"]["arrivals"][1]["peer"] == "peerA"
+        assert rec["prevote"]["signed"]["t"] > 0
 
     def test_reset_and_resize(self):
         fr = FlightRecorder(enabled=True)

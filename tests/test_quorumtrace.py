@@ -1,0 +1,501 @@
+"""Quorum observatory: cross-node journey fusion + the live analyzer.
+
+Unit tier: build_journeys skew correction (raw ``t_ns`` reconciles exactly
+with the receiver's stamps; ``t_mono_ns`` is the clamped monotone view),
+completion_curve's strict-2/3 boundary and deterministic pivotal naming,
+gossip_ledger waste accounting, flush_attribution's height join, the
+QuorumTrace ring/snapshot contract and its never-raise guarantee, and
+quorum_report's cross-node fusion (absent sweep, pivotal majority
+tie-break) over synthetic dumps.
+
+Harness tier: a real ConsensusState commits a height with scripted peer
+votes; the live analyzer must record a curve whose pivotal naming
+re-derives bit-identically from the flight record and whose time-to-2/3
+histograms land in the metric exposition.
+"""
+
+import importlib.util
+import json
+import os
+import sys
+
+import pytest
+
+from tendermint_tpu.consensus.messages import VoteMessage
+from tendermint_tpu.libs.metrics import NodeMetrics
+from tendermint_tpu.libs.quorumtrace import (
+    QuorumTrace,
+    build_journeys,
+    completion_curve,
+    flush_attribution,
+    gossip_ledger,
+)
+from tendermint_tpu.types import BlockID, SignedMsgType
+
+from tests.consensus_harness import make_consensus_state, wait_for
+
+
+def _load_script(name):
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "scripts", f"{name}.py",
+    )
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _slot(**kw):
+    base = {"first": None, "last": None, "count": 0, "by_peer": {},
+            "signed": None, "first_send": {}, "arrivals": {},
+            "contrib": {}, "dup_by_peer": {}}
+    base.update(kw)
+    return base
+
+
+def _rec(height, t0=1_000, **slots):
+    rec = {"height": height, "rounds": [{"round": 0, "t": t0}],
+           "proposal": None, "block_parts": None, "polka": None,
+           "commit": None, "persist": None, "exec": None,
+           "prevote": _slot(), "precommit": _slot()}
+    rec.update(slots)
+    return rec
+
+
+def _dump(node_id, records):
+    return {"node_id": node_id, "records": records}
+
+
+# -- build_journeys ----------------------------------------------------------------
+
+
+class TestBuildJourneys:
+    def _two_node_dumps(self):
+        """n0 signs vi=0 at t=1000 and sends it; n1 (clock 600ns behind the
+        reference after correction math, i.e. skew +600 to add) saw it at
+        its local t=500."""
+        d0 = _dump("n0", [_rec(1, prevote=_slot(
+            signed={"t": 1_000, "round": 0, "validator_index": 0},
+            first_send={0: {"t": 1_050, "round": 0, "peer": "n1"}},
+        ))])
+        d1 = _dump("n1", [_rec(1, prevote=_slot(
+            arrivals={0: {"t": 500, "round": 0, "peer": "n0"}},
+            contrib={0: {"t": 520, "round": 0, "power": 10}},
+        ))])
+        return d0, d1
+
+    def test_skew_correction_is_exact(self):
+        d0, d1 = self._two_node_dumps()
+        (j,) = build_journeys([d0, d1], {"n0": 0, "n1": 600})
+        assert (j["height"], j["kind"], j["validator_index"]) == \
+            (1, "prevote", 0)
+        assert j["origin"] == "n0" and j["signed_ns"] == 1_000
+        assert j["first_send"]["t_ns"] == 1_050
+        # raw corrected stamp: EXACTLY receiver's stamp + its skew
+        assert j["arrivals"]["n1"]["t_ns"] == 500 + 600
+        assert j["arrivals"]["n1"]["t_mono_ns"] == 1_100  # already monotone
+        assert j["contrib"]["n1"]["power"] == 10
+        assert j["clamped"] is False
+
+    def test_residual_inversion_clamps_monotone_view_only(self):
+        d0, d1 = self._two_node_dumps()
+        # under-corrected receiver: arrival lands "before" signing
+        (j,) = build_journeys([d0, d1], {"n0": 0, "n1": 300})
+        assert j["arrivals"]["n1"]["t_ns"] == 800  # raw kept for reconcile
+        assert j["arrivals"]["n1"]["t_mono_ns"] == 1_050  # clamped to send
+        assert j["clamped"] is True
+
+    def test_first_send_clamps_and_floors_arrivals(self):
+        d0 = _dump("n0", [_rec(1, prevote=_slot(
+            signed={"t": 2_000, "round": 0, "validator_index": 0},
+            first_send={0: {"t": 1_900, "round": 0, "peer": "n1"}},
+        ))])
+        (j,) = build_journeys([d0], {})
+        assert j["first_send"]["t_ns"] == 1_900
+        assert j["first_send"]["t_mono_ns"] == 2_000
+        assert j["clamped"] is True
+
+    def test_json_round_trip_string_keys(self):
+        d0, d1 = self._two_node_dumps()
+        wire = [json.loads(json.dumps(d)) for d in (d0, d1)]
+        assert build_journeys(wire, {"n0": 0, "n1": 600}) == \
+            build_journeys([d0, d1], {"n0": 0, "n1": 600})
+
+    def test_originless_journey_is_not_clamped(self):
+        _, d1 = self._two_node_dumps()
+        (j,) = build_journeys([d1], {"n1": 600})
+        assert j["origin"] is None and j["signed_ns"] is None
+        assert j["arrivals"]["n1"]["t_mono_ns"] == \
+            j["arrivals"]["n1"]["t_ns"]
+        assert j["clamped"] is False
+
+    def test_sorted_by_height_kind_validator(self):
+        d = _dump("n0", [
+            _rec(2, prevote=_slot(
+                arrivals={1: {"t": 5, "round": 0, "peer": "p"},
+                          0: {"t": 6, "round": 0, "peer": "p"}})),
+            _rec(1, precommit=_slot(
+                arrivals={0: {"t": 1, "round": 0, "peer": "p"}})),
+        ])
+        keys = [(j["height"], j["kind"], j["validator_index"])
+                for j in build_journeys([d])]
+        assert keys == sorted(keys)
+
+
+# -- completion_curve --------------------------------------------------------------
+
+
+def _contrib_rec(arrivals, height=1, t0=0, kind="precommit"):
+    contrib = {vi: {"t": t, "round": 0, "power": p}
+               for t, vi, p in arrivals}
+    return _rec(height, t0=t0, **{kind: _slot(contrib=contrib)})
+
+
+class TestCompletionCurve:
+    def test_strict_two_thirds_boundary(self):
+        # 3 of 30 power-10 arrivals: 20/30 is EXACTLY 2/3 -> must not cross
+        rec = _contrib_rec([(10, 0, 10), (20, 1, 10), (30, 2, 10)])
+        curve = completion_curve(rec, "precommit", 30)
+        cr = curve["crossings"]
+        assert cr["third"]["validator_index"] == 0  # 10*3 >= 30
+        assert cr["half"]["validator_index"] == 1   # 20*2 >= 30
+        assert cr["two_thirds"]["validator_index"] == 2  # 20*3 > 60 is False
+        assert cr["two_thirds"]["cum_power"] == 30
+        assert curve["pivotal_validator"] == 2
+        assert curve["present"] == [0, 1, 2]
+
+    def test_pivotal_is_a_pure_function_of_the_stamps(self):
+        rec = _contrib_rec([(30, 2, 10), (10, 0, 10), (20, 1, 10)])
+        first = completion_curve(rec, "precommit", 30)
+        again = completion_curve(rec, "precommit", 30)
+        assert first == again
+        # insertion order of the contrib dict is irrelevant: arrivals sort
+        # by (t, vi, power) before accumulation
+        shuffled = _contrib_rec([(20, 1, 10), (30, 2, 10), (10, 0, 10)])
+        assert completion_curve(shuffled, "precommit", 30) == first
+
+    def test_seconds_measured_from_round_entry(self):
+        rec = _contrib_rec(
+            [(2_000_000_000, 0, 10), (3_000_000_000, 1, 10),
+             (4_500_000_000, 2, 10)],
+            t0=1_000_000_000,
+        )
+        curve = completion_curve(rec, "precommit", 30)
+        assert curve["crossings"]["two_thirds"]["seconds"] == \
+            pytest.approx(3.5)
+
+    def test_skew_shifts_stamps_not_durations(self):
+        rec = _contrib_rec([(10, 0, 10), (20, 1, 10), (30, 2, 10)], t0=5)
+        a = completion_curve(rec, "precommit", 30)
+        b = completion_curve(rec, "precommit", 30, skew_ns=1_000)
+        assert b["t0_ns"] == a["t0_ns"] + 1_000
+        assert b["crossings"]["two_thirds"]["t_ns"] == \
+            a["crossings"]["two_thirds"]["t_ns"] + 1_000
+        assert b["crossings"]["two_thirds"]["seconds"] == \
+            a["crossings"]["two_thirds"]["seconds"]
+
+    def test_none_without_rounds_contrib_or_power(self):
+        assert completion_curve(_rec(1), "prevote", 30) is None
+        rec = _contrib_rec([(10, 0, 10)])
+        rec["rounds"] = []
+        assert completion_curve(rec, "precommit", 30) is None
+        assert completion_curve(
+            _contrib_rec([(10, 0, 10)]), "precommit", 0) is None
+
+    def test_incomplete_quorum_names_no_pivotal(self):
+        rec = _contrib_rec([(10, 0, 10), (20, 1, 10)])
+        curve = completion_curve(rec, "precommit", 30)
+        assert curve["crossings"]["two_thirds"] is None
+        assert curve["pivotal_validator"] is None
+        assert curve["present_power"] == 20
+
+    def test_json_round_trip_string_keys(self):
+        rec = json.loads(json.dumps(
+            _contrib_rec([(10, 0, 10), (20, 1, 10), (30, 2, 10)])
+        ))
+        assert completion_curve(rec, "precommit", 30)[
+            "pivotal_validator"] == 2
+
+
+# -- gossip_ledger -----------------------------------------------------------------
+
+
+class TestGossipLedger:
+    def test_waste_ratio_and_links(self):
+        d0 = _dump("n0", [_rec(1, prevote=_slot(
+            arrivals={1: {"t": 10, "round": 0, "peer": "n1"},
+                      2: {"t": 12, "round": 0, "peer": "n2"}},
+            dup_by_peer={"n1": 3},
+        ))])
+        ledger = gossip_ledger([d0])
+        assert ledger["first_sightings"] == 2
+        assert ledger["duplicates"] == 3
+        assert ledger["waste_ratio"] == pytest.approx(1.5)
+        by_link = {(l["peer"], l["node"]): l for l in ledger["links"]}
+        assert by_link[("n1", "n0")]["first_sightings"] == 1
+        assert by_link[("n1", "n0")]["duplicates"] == 3
+        assert by_link[("n2", "n0")]["duplicates"] == 0
+
+    def test_latency_joined_from_journeys(self):
+        d0 = _dump("n0", [_rec(1, prevote=_slot(
+            signed={"t": 1_000, "round": 0, "validator_index": 0},
+        ))])
+        d1 = _dump("n1", [_rec(1, prevote=_slot(
+            arrivals={0: {"t": 1_500, "round": 0, "peer": "n0"}},
+        ))])
+        journeys = build_journeys([d0, d1])
+        ledger = gossip_ledger([d0, d1], journeys=journeys)
+        (link,) = [l for l in ledger["links"] if l["latency_samples"]]
+        assert (link["peer"], link["node"]) == ("n0", "n1")
+        assert link["latency_p50_s"] == pytest.approx(500 / 1e9)
+
+    def test_empty_dumps(self):
+        ledger = gossip_ledger([])
+        assert ledger["waste_ratio"] == 0.0 and ledger["links"] == []
+
+
+# -- flush_attribution -------------------------------------------------------------
+
+
+class TestFlushAttribution:
+    def test_joins_on_height(self):
+        flushes = {"records": [
+            {"reason": "window", "groups": [[1, 0, 1], [1, 0, 2]]},
+            {"reason": "rows", "groups": [[2, 0, 1]]},
+            {"reason": "window", "groups": [["2", "0", "2"]]},  # wire strs
+        ]}
+        assert [f["reason"] for f in flush_attribution(flushes, 2)] == \
+            ["rows", "window"]
+        assert flush_attribution(flushes, 9) == []
+
+    def test_none_and_empty(self):
+        assert flush_attribution(None, 1) == []
+        assert flush_attribution({"records": []}, 1) == []
+
+
+# -- QuorumTrace (live analyzer) ---------------------------------------------------
+
+
+class _FakeFlight:
+    def __init__(self, rec, node_id="n0", enabled=True):
+        self.enabled = enabled
+        self.node_id = node_id
+        self._rec = rec
+
+    def peek(self, height):
+        if isinstance(self._rec, Exception):
+            raise self._rec
+        return self._rec if self._rec and \
+            self._rec.get("height") == height else None
+
+
+class _FakeValset:
+    def __init__(self, total):
+        self._total = total
+
+    def total_voting_power(self):
+        return self._total
+
+
+class _FakeFeed:
+    def __init__(self, records):
+        self._records = records
+
+    def flush_records(self):
+        return {"records": self._records}
+
+
+class TestQuorumTrace:
+    def _rec(self):
+        return _contrib_rec([(10, 0, 10), (20, 1, 10), (30, 2, 10)])
+
+    def test_analyze_records_curves_and_metrics(self):
+        nm = NodeMetrics()
+        qt = QuorumTrace(metrics=nm)
+        out = qt.on_height_complete(
+            1, _FakeFlight(self._rec()), validators=_FakeValset(30),
+            vote_feed=_FakeFeed([{"reason": "window", "groups": [[1, 0, 2]]}]),
+        )
+        assert out is not None and len(qt) == 1
+        assert qt.node_id == "n0"
+        curve = out["curves"]["precommit"]
+        assert curve["pivotal_validator"] == 2
+        assert curve["total_power"] == 30
+        assert [f["reason"] for f in out["flushes"]] == ["window"]
+        text = nm.registry.expose_text()
+        assert ('tendermint_consensus_quorum_time_to_two_thirds_seconds_count'
+                '{type="precommit"} 1') in text
+        assert ('tendermint_consensus_quorum_time_to_third_seconds_count'
+                '{type="precommit"} 1') in text
+
+    def test_no_valset_scales_by_arrived_power(self):
+        qt = QuorumTrace()
+        out = qt.on_height_complete(1, _FakeFlight(self._rec()))
+        # record says the valset total was unknown; the curve scaled by
+        # the power that DID arrive, so the last arrival is pivotal
+        assert out["total_power"] == 0
+        assert out["curves"]["precommit"]["total_power"] == 30
+        assert out["curves"]["precommit"]["pivotal_validator"] == 2
+
+    def test_disabled_flight_and_missing_record_are_none(self):
+        qt = QuorumTrace()
+        assert qt.on_height_complete(
+            1, _FakeFlight(self._rec(), enabled=False)) is None
+        assert qt.on_height_complete(9, _FakeFlight(self._rec())) is None
+        assert len(qt) == 0
+
+    def test_never_raises_into_consensus(self):
+        qt = QuorumTrace()
+        assert qt.on_height_complete(
+            1, _FakeFlight(RuntimeError("boom"))) is None
+        assert qt.analysis_errors == 1
+
+    def test_ring_eviction_and_snapshot_contract(self):
+        qt = QuorumTrace(capacity=2)
+        for h in (1, 2, 3):
+            rec = self._rec()
+            rec["height"] = h
+            qt.on_height_complete(h, _FakeFlight(rec))
+        snap = qt.snapshot()
+        assert snap["total_records"] == 2 and snap["evicted"] == 1
+        assert [r["height"] for r in snap["records"]] == [2, 3]
+        cut = qt.snapshot(limit=1)
+        assert cut["truncated"] is True
+        assert [r["height"] for r in cut["records"]] == [3]
+        assert qt.snapshot(limit=0)["records"] == []
+        # the rolling percentile window is sized independently of the
+        # record ring: all 3 heights still sample the stats
+        stats = snap["quorum_stats"]["precommit"]
+        assert stats["n"] == 3
+        assert stats["two_thirds_p99_seconds"] is not None
+
+    def test_reset_clears_and_validates_capacity(self):
+        qt = QuorumTrace()
+        qt.on_height_complete(1, _FakeFlight(self._rec()))
+        qt.reset(capacity=4)
+        assert len(qt) == 0 and qt.capacity == 4
+        with pytest.raises(ValueError):
+            qt.reset(capacity=0)
+        with pytest.raises(ValueError):
+            QuorumTrace(capacity=-1)
+
+
+# -- quorum_report fusion ----------------------------------------------------------
+
+
+class TestQuorumReport:
+    @pytest.fixture(scope="class")
+    def qr(self):
+        return _load_script("quorum_report")
+
+    def _quorum_dump(self, node_id, pivotal, present, height=1):
+        return {"node_id": node_id, "records": [{
+            "height": height, "node_id": node_id, "total_power": 30,
+            "curves": {"precommit": {
+                "height": height, "kind": "precommit", "t0_ns": 0,
+                "total_power": 30, "present_power": 30,
+                "present": present,
+                "crossings": {"third": None, "half": None,
+                              "two_thirds": {"t_ns": 30, "seconds": 0.03,
+                                             "validator_index": pivotal,
+                                             "cum_power": 30}},
+                "pivotal_validator": pivotal,
+            }},
+            "gossip": {"first_sightings": 2, "duplicates": 1,
+                       "dup_by_peer": {"x": 1}},
+            "flushes": [],
+        }], "quorum_stats": {}}
+
+    def test_absent_sweep_and_pivotal_majority(self, qr):
+        flights = [_dump("n0", [_rec(1)]), _dump("n1", [_rec(1)])]
+        quorums = [self._quorum_dump("n0", 2, [0, 1, 2]),
+                   self._quorum_dump("n1", 1, [0, 1, 2])]
+        report = qr.build_report(flights, quorums, n_validators=4)
+        entry = report["heights"]["1"]
+        assert entry["absent_validators"] == [3]
+        # 1-1 tie between pivotal 1 and 2 -> deterministic lower index
+        assert entry["pivotal"]["precommit"] == 1
+        assert qr.absent_everywhere(report) == [3]
+
+    def test_n_validators_inferred_from_dumps(self, qr):
+        flights = [_dump("n0", [_rec(1)])]
+        quorums = [self._quorum_dump("n0", 2, [0, 1, 2])]
+        report = qr.build_report(flights, quorums)
+        assert report["n_validators"] == 3
+        assert report["heights"]["1"]["absent_validators"] == []
+
+    def test_no_heights_means_no_absent_claim(self, qr):
+        report = qr.build_report([_dump("n0", [])], [])
+        assert qr.absent_everywhere(report) == []
+
+
+# -- harness tier ------------------------------------------------------------------
+
+
+class TestQuorumTraceHarness:
+    def test_live_record_rederives_from_flight_dump(self):
+        """Commit height 1 with scripted peer votes: the analyzer's curve
+        must name a pivotal validator whose crossing satisfies the strict
+        2/3 rule and re-derive bit-identically from the flight record."""
+        from tendermint_tpu.libs.quorumtrace import completion_curve
+
+        for our_index in range(4):
+            cs, stubs, bus = make_consensus_state(4, our_index=our_index)
+            cs.flight.node_id = "me"
+            cs.flight.enable()
+            cs.start()
+            try:
+                if not wait_for(
+                    lambda: cs.get_round_state().step.value >= 3, timeout=10.0
+                ):
+                    continue
+                if not cs._is_proposer():
+                    continue
+                assert wait_for(
+                    lambda: cs.get_round_state().proposal_block is not None,
+                    timeout=20.0,
+                )
+                rs = cs.get_round_state()
+                bid = BlockID(
+                    hash=rs.proposal_block.hash(),
+                    parts_header=rs.proposal_block_parts.header(),
+                )
+                for kind in (SignedMsgType.PREVOTE, SignedMsgType.PRECOMMIT):
+                    for stub in stubs:
+                        vote = stub.sign_vote(kind, bid, 1, 0)
+                        cs.send_peer_msg(
+                            VoteMessage(vote), f"peer{stub.index}")
+                assert wait_for(lambda: len(cs.quorumtrace) >= 1,
+                                timeout=20.0), \
+                    "quorum analyzer never recorded the committed height"
+                (qrec,) = [r for r in cs.quorumtrace.records()
+                           if r["height"] == 1]
+                assert qrec["node_id"] == "me"
+                frec = cs.flight.peek(1)
+                for kind in ("prevote", "precommit"):
+                    curve = qrec["curves"][kind]
+                    assert curve["pivotal_validator"] is not None
+                    assert curve["total_power"] == qrec["total_power"] > 0
+                    # deterministic re-derivation from the dump
+                    redo = completion_curve(
+                        frec, kind, curve["total_power"])
+                    assert redo["pivotal_validator"] == \
+                        curve["pivotal_validator"]
+                    assert redo["crossings"] == curve["crossings"]
+                    # the height finalizes once strict 2/3 lands, so at
+                    # least 3 of 4 equal-power validators contributed
+                    # (the 4th vote may arrive after the analyzer ran)
+                    assert len(curve["present"]) >= 3
+                    assert set(curve["present"]) <= {0, 1, 2, 3}
+                    assert curve["crossings"]["two_thirds"]["cum_power"] \
+                        * 3 > curve["total_power"] * 2
+                # arrivals/dup accounting lives at the REACTOR receive
+                # seam, which this harness bypasses — the sim scenario
+                # and quorum smoke cover that path against real gossip
+                return
+            finally:
+                cs.stop()
+                bus.stop()
+        pytest.skip("no configuration made our node the proposer")
