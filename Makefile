@@ -7,7 +7,7 @@ PYTHON ?= python
         lite-bench multichip-bench vote-bench metrics-lint bench-check \
         statesync-smoke \
         flight-smoke chaos-smoke critpath-smoke critpath-bench \
-        quorum-smoke \
+        quorum-smoke soak-smoke \
         localnet-start localnet-stop build-docker-localnode
 
 test:
@@ -130,6 +130,18 @@ quorum-smoke:
 	JAX_PLATFORMS=cpu $(PYTHON) scripts/quorum_smoke.py
 	$(PYTHON) scripts/bench_check.py --prefix QUORUM \
 	  --metric quorum_time_to_two_thirds_p99_seconds:0.25:lower
+
+# soak observatory end to end on the sim fabric: 4 validators past 200
+# heights through a mid-run fault leg, one node crashed (torn spool frame
+# included) and rebuilt; whole-run sketch quantiles must match exact
+# offline percentiles within the configured relative error, the fleet
+# merge must be bucket-identical to merging per-node sketches, pre-crash
+# spool legs must survive the rebuild, and the appended SOAK_rNN.json
+# round gates soak_commit_p99_seconds (lower is better)
+soak-smoke:
+	JAX_PLATFORMS=cpu $(PYTHON) scripts/soak_smoke.py
+	$(PYTHON) scripts/bench_check.py --prefix SOAK \
+	  --metric soak_commit_p99_seconds:0.25:lower
 
 # signing-to-commit p99 under vote_storm + mempool_flood on the sim
 # fabric, pooled from every node's critical-path waterfalls; appends a

@@ -69,6 +69,10 @@ DEFAULT_METRICS = [
     # observatory (scripts/quorum_smoke.py / make quorum-smoke —
     # QUORUM_r*.json rounds via --prefix); latency: lower is better
     "quorum_time_to_two_thirds_p99_seconds:0.25:lower",
+    # fleet-merged whole-run commit p99 from the soak observatory's
+    # telemetry spools (scripts/soak_smoke.py / make soak-smoke —
+    # SOAK_r*.json rounds via --prefix); latency: lower is better
+    "soak_commit_p99_seconds:0.25:lower",
 ]
 DEFAULT_THRESHOLD = 0.20
 

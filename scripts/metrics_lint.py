@@ -371,6 +371,17 @@ def _self_check():
     nm.record_vote_sighting("b7c2", 0x22, first=True)
     nm.quorum_time_to_third.observe(0.012, ("prevote",))
     nm.quorum_time_to_two_thirds.observe(0.045, ("precommit",))
+    # soak-observatory telemetry families (libs/telemetry.py spool feeds
+    # them): counters, the spool-size gauge, and every store label of the
+    # eviction counter must emit lintable samples
+    nm.telemetry.snapshots.add(3.0)
+    nm.telemetry.spool_bytes.set(8192.0)
+    nm.telemetry.write_errors.add(1.0)
+    nm.telemetry.dropped.add(1.0)
+    from tendermint_tpu.libs.telemetry import EVICTION_STORES
+
+    for _store in EVICTION_STORES:
+        nm.telemetry.evicted.add(2.0, (_store,))
     nm.forget_peer("f3a1")  # removal must leave the exposition lintable
 
     failures = []
@@ -525,6 +536,29 @@ def _self_check():
         failures.append(
             ("mempool-batch family parity",
              [f"missing family {n}" for n in missing_mb])
+        )
+    # telemetry family parity: the soak observatory's spool health
+    # (tm_monitor's SPOOL column, soak_report's loss accounting) scrapes
+    # these exact names; TelemetryMetrics is per-node (in-process sim nets
+    # must not pool spool_bytes gauges), attached by the NodeMetrics ctor
+    telemetry_names = (
+        "tendermint_telemetry_snapshots_total",
+        "tendermint_telemetry_spool_bytes",
+        "tendermint_telemetry_write_errors_total",
+        "tendermint_telemetry_dropped_snapshots_total",
+        "tendermint_observability_evicted_total",
+    )
+    missing_tel = [
+        n for n in telemetry_names if f"# TYPE {n} " not in node_text
+    ]
+    missing_tel.extend(
+        f'store label "{s}"' for s in EVICTION_STORES
+        if f'store="{s}"' not in node_text
+    )
+    if missing_tel:
+        failures.append(
+            ("telemetry family parity",
+             [f"missing {n}" for n in missing_tel])
         )
     for label, text in (
         ("escaping registry", r.expose_text()),

@@ -1,0 +1,392 @@
+"""Soak observatory smoke test (`make soak-smoke`).
+
+Drives the whole soak pipeline — mergeable sketches, the crash-safe
+telemetry spool, and the fleet report — end to end, in one process, on
+CPU: a 4-validator `build_sim_net` mesh runs past 200 heights through
+three regimes (clean, a mid-run fault leg with injected link latency,
+clean again), each node spooling height-triggered telemetry snapshots to
+its own on-disk segment group, with one node crashed mid-run — torn
+spool frame and all — and rebuilt from its durable stores:
+
+  1. **Sketch accuracy** — per node, the whole-run commit sketch must
+     agree with the exact nearest-rank percentiles computed offline from
+     the full critpath record list, within the sketch's configured
+     relative error, and must have counted every committed height.
+  2. **Crash safety** — the victim's spool survives kill-style shutdown
+     plus a torn appended frame: the rebuilt spool truncates the torn
+     tail on reopen (recovered_bytes > 0), every pre-crash snapshot is
+     still byte-for-byte readable, and post-crash snapshots append
+     cleanly behind them.
+  3. **Merge exactness** — the fleet-merged sketch from
+     scripts/soak_report.py is bucket-for-bucket identical to manually
+     merging the per-node sketches, in any merge order.
+  4. **Loss accounting** — with the flight ring deliberately undersized,
+     `tendermint_observability_evicted_total{store="flight"}` must tick
+     on every node, the telemetry families must expose, and every node's
+     exposition must pass the strict metrics_lint parser.
+  5. a SOAK_rNN.json round whose parsed soak_commit_p99_seconds feeds
+     `make soak-smoke`'s bench_check regression gate.
+"""
+
+import glob
+import json
+import math
+import os
+import re
+import shutil
+import sys
+import tempfile
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _ROOT)
+
+import soak_report  # noqa: E402  (sibling script)
+from metrics_lint import lint_text  # noqa: E402  (sibling script)
+
+from tendermint_tpu.config.config import test_config  # noqa: E402
+from tendermint_tpu.libs.sketch import QuantileSketch  # noqa: E402
+from tendermint_tpu.libs.telemetry import (  # noqa: E402
+    TelemetrySpool,
+    encode_record,
+    node_sources,
+    read_spool,
+)
+from tendermint_tpu.sim.node import SimNode, build_sim_net  # noqa: E402
+from tendermint_tpu.sim.simnet import LinkPolicy  # noqa: E402
+
+N_VALS = 4
+SEED = 29
+TARGET_HEIGHT = 210        # >= 200 heights of soak
+FAULT_AT = 70              # fault leg: injected link latency ...
+FAULT_CLEAR = 120          # ... lifted here
+CRASH_AT = 140             # victim killed + rebuilt here
+VICTIM = 2
+FAULT_POLICY = LinkPolicy(delay_s=0.02, jitter_s=0.02)
+
+SPOOL_INTERVAL_HEIGHTS = 10  # height-triggered snapshots only
+FLIGHT_CAPACITY = 32         # undersized on purpose: evictions must tick
+CRITPATH_CAPACITY = 2048     # oversized on purpose: exact offline reference
+
+TELEMETRY_FAMILIES = (
+    "tendermint_telemetry_snapshots_total",
+    "tendermint_telemetry_spool_bytes",
+    "tendermint_telemetry_write_errors_total",
+    "tendermint_telemetry_dropped_snapshots_total",
+    "tendermint_observability_evicted_total",
+)
+
+
+def _wait(pred, timeout: float, interval: float = 0.05) -> bool:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def _prep_node(node) -> None:
+    """Per-node observability shaping: tiny flight ring (so eviction
+    accounting has something to count), huge critpath ring (the exact
+    reference the sketch is judged against)."""
+    node.cs.flight.enable(capacity=FLIGHT_CAPACITY)
+    node.cs.critpath.reset(capacity=CRITPATH_CAPACITY)
+
+
+def _make_spool(node, tmp: str) -> TelemetrySpool:
+    """The same wiring node.py gives a production node, on a SimNode."""
+    path = os.path.join(tmp, node.node_id, "spool")
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    spool = TelemetrySpool(
+        path,
+        node_id=node.node_id,
+        interval_heights=SPOOL_INTERVAL_HEIGHTS,
+        interval_seconds=0.0,  # height-triggered only: deterministic legs
+        ring_capacity=64,
+        metrics=node.metrics.telemetry,
+        height_fn=lambda n=node: n.cs.rs.height,
+    )
+    node.consensus_state = node.cs  # node_sources speaks full-node layout
+    for name, fn in node_sources(node).items():
+        spool.set_source(name, fn)
+    spool.set_source("spool", spool.status)
+    spool.start()
+    return spool
+
+
+def _exact_percentile(xs, q: float) -> float:
+    """Exact nearest-rank percentile — the ground truth the sketch's
+    relative-error guarantee is stated against."""
+    ordered = sorted(xs)
+    rank = max(1, math.ceil(q * len(ordered)))
+    return ordered[rank - 1]
+
+
+def _check_sketch_accuracy(node_id: str, crit: dict, failures: list) -> None:
+    exact = [rec["commit_seconds"] for rec in crit["records"]]
+    if crit["evicted"]:
+        failures.append(
+            f"{node_id}: critpath evicted {crit['evicted']} records — "
+            f"exact reference incomplete (raise CRITPATH_CAPACITY)"
+        )
+        return
+    sk = QuantileSketch.from_dict(crit["sketches"]["commit"])
+    if sk.count != len(exact):
+        failures.append(
+            f"{node_id}: commit sketch counted {sk.count} samples, "
+            f"critpath ring holds {len(exact)}"
+        )
+        return
+    if not exact:
+        failures.append(f"{node_id}: no commit samples at all")
+        return
+    for q in (0.50, 0.90, 0.99):
+        est = sk.quantile(q)
+        truth = _exact_percentile(exact, q)
+        # the DDSketch guarantee: |est - x| <= alpha * x for the sample x
+        # at the requested rank
+        if abs(est - truth) > sk.alpha * truth + 1e-12:
+            failures.append(
+                f"{node_id}: q={q} sketch={est:.6f}s exact={truth:.6f}s "
+                f"violates the {sk.alpha:.0%} relative-error bound"
+            )
+
+
+def _sketchdicts_equal(a: dict, b: dict) -> bool:
+    """Bit-exact on everything the merge guarantee covers; ``sum`` is
+    float-addition order-sensitive by design, so it gets a tolerance."""
+    keys = ("kind", "alpha", "count", "min", "max", "zero", "buckets")
+    if any(a.get(k) != b.get(k) for k in keys):
+        return False
+    return math.isclose(a["sum"], b["sum"], rel_tol=1e-9, abs_tol=1e-12)
+
+
+def _check_fleet_merge(report: dict, failures: list) -> None:
+    fleet = (report.get("fleet") or {}).get("critpath/commit")
+    if not fleet or fleet["n"] == 0:
+        failures.append("report has no fleet commit sketch")
+        return
+    per_node = {
+        node: d["critpath/commit"]
+        for node, d in (report.get("per_node_final") or {}).items()
+        if "critpath/commit" in d
+    }
+    if len(per_node) != N_VALS:
+        failures.append(
+            f"per_node_final commit sketches from {sorted(per_node)} "
+            f"(want all {N_VALS} nodes)"
+        )
+        return
+    orders = [sorted(per_node), sorted(per_node, reverse=True)]
+    merges = [
+        QuantileSketch.merged(
+            [QuantileSketch.from_dict(per_node[n]) for n in order]
+        ).to_dict()
+        for order in orders
+    ]
+    if not _sketchdicts_equal(merges[0], merges[1]):
+        failures.append("merge order changed the fleet sketch buckets")
+    if not _sketchdicts_equal(fleet["sketch"], merges[0]):
+        failures.append(
+            "fleet-merged sketch != manual merge of per-node sketches"
+        )
+
+
+def _check_exposition(node_id: str, text: str, failures: list) -> None:
+    for name in TELEMETRY_FAMILIES:
+        if f"# TYPE {name} " not in text:
+            failures.append(f"{node_id}: exposition missing {name}")
+    if 'tendermint_observability_evicted_total{store="flight"}' not in text:
+        failures.append(
+            f"{node_id}: no flight eviction sample despite the "
+            f"{FLIGHT_CAPACITY}-height ring"
+        )
+    failures.extend(f"{node_id} metrics_lint: {e}" for e in lint_text(text))
+
+
+def _write_round(round_dir: str, parsed: dict) -> str:
+    ns = [
+        int(m.group(1))
+        for p in glob.glob(os.path.join(round_dir, "SOAK_r*.json"))
+        if (m := re.search(r"SOAK_r(\d+)\.json$", os.path.basename(p)))
+    ]
+    path = os.path.join(round_dir, f"SOAK_r{max(ns, default=0) + 1:02d}.json")
+    with open(path, "w") as f:
+        json.dump({"rc": 0, "parsed": parsed}, f, indent=2)
+        f.write("\n")
+    print(f"[soak-smoke] round -> {path}")
+    return path
+
+
+def main() -> int:
+    failures = []
+    tmp = tempfile.mkdtemp(prefix="soak-smoke-")
+    fabric, nodes = build_sim_net(N_VALS, seed=SEED, config=test_config())
+    for n in nodes:
+        _prep_node(n)
+    spools = {n.node_id: _make_spool(n, tmp) for n in nodes}
+    victim_id = nodes[VICTIM].node_id
+    victim_spool_path = spools[victim_id].path
+    pre_crash = None
+    try:
+        fabric.start()
+        for n in nodes:
+            n.start()
+        print(f"[soak-smoke] {N_VALS}-node net -> height {TARGET_HEIGHT} "
+              f"(fault ({FAULT_AT},{FAULT_CLEAR}], crash v{VICTIM} at "
+              f"{CRASH_AT})...")
+
+        if not _wait(lambda: all(n.height >= FAULT_AT for n in nodes),
+                     timeout=180.0):
+            return _fail([f"never reached fault leg: "
+                          f"{[n.height for n in nodes]}"])
+        print("[soak-smoke] fault leg: injecting link latency...")
+        fabric.set_policy(None, None, FAULT_POLICY)
+        if not _wait(lambda: all(n.height >= FAULT_CLEAR for n in nodes),
+                     timeout=180.0):
+            return _fail([f"stuck inside the fault leg: "
+                          f"{[n.height for n in nodes]}"])
+        fabric.set_policy(None, None, LinkPolicy())
+        if not _wait(lambda: all(n.height >= CRASH_AT for n in nodes),
+                     timeout=180.0):
+            return _fail([f"never reached crash height: "
+                          f"{[n.height for n in nodes]}"])
+
+        # crash the victim the unclean way: no shutdown snapshot, and a
+        # torn half-frame appended to the spool head — exactly the disk a
+        # kill -9 mid-write leaves behind
+        print(f"[soak-smoke] crashing {victim_id} "
+              f"(torn spool frame included)...")
+        spools[victim_id].kill()
+        pre_crash = read_spool(victim_spool_path)
+        if not pre_crash["snapshots"]:
+            failures.append("victim spooled nothing before the crash")
+        with open(victim_spool_path, "ab") as f:
+            f.write(encode_record(b'{"torn":true}\n')[:9])
+        old = nodes[VICTIM]
+        old.crash()
+        rebuilt = SimNode(
+            index=old.index, node_id=old.node_id, doc=old.doc, pv=old.pv,
+            fabric=fabric, config=old.config, clock=old.clock,
+            state_db=old.state_db, block_store=old.block_store,
+            handshake=True,
+        )
+        for other in nodes:
+            if other is not old:
+                rebuilt.switch.connect(other.node_id)
+                other.switch.connect(rebuilt.node_id)
+        nodes[VICTIM] = rebuilt
+        _prep_node(rebuilt)
+        spools[victim_id] = _make_spool(rebuilt, tmp)
+        recovered = spools[victim_id].status()["recovered_bytes"]
+        if recovered <= 0:
+            failures.append(
+                f"rebuilt spool recovered {recovered} bytes (torn tail "
+                f"not truncated)"
+            )
+        rebuilt.start()
+
+        if not _wait(lambda: all(n.height >= TARGET_HEIGHT for n in nodes),
+                     timeout=300.0):
+            return _fail([f"never reached target height: "
+                          f"{[n.height for n in nodes]}"])
+    finally:
+        for n in nodes:
+            n.stop()
+        fabric.stop()
+
+    # clean shutdown: each surviving spool appends its final cumulative
+    # snapshot; heights are frozen, so the spool's last sketches align
+    # exactly with the critpath rings sampled below
+    for spool in spools.values():
+        spool.stop()
+
+    # 1. sketch vs exact offline percentiles, per node
+    crits = {n.node_id: n.cs.critpath.snapshot() for n in nodes}
+    for node_id, crit in crits.items():
+        _check_sketch_accuracy(node_id, crit, failures)
+
+    # 2. crash safety: pre-crash snapshots intact, post-crash appended
+    full = read_spool(victim_spool_path)
+    n_pre = len(pre_crash["snapshots"]) if pre_crash else 0
+    if len(full["snapshots"]) <= n_pre:
+        failures.append(
+            f"victim spool has {len(full['snapshots'])} snapshots, "
+            f"{n_pre} pre-crash — nothing appended after rebuild"
+        )
+    if pre_crash and full["snapshots"][:n_pre] != pre_crash["snapshots"]:
+        failures.append("pre-crash snapshots changed across the rebuild")
+    if full["corrupt_frames"]:
+        failures.append(
+            f"victim spool reports {full['corrupt_frames']} corrupt frames"
+        )
+    seqs = [s["seq"] for s in full["snapshots"]]
+    if sum(1 for a, b in zip(seqs, seqs[1:]) if b < a) != 1:
+        failures.append(
+            f"expected exactly one seq reset (the restart), got seqs={seqs}"
+        )
+
+    # 3. fleet report + merge exactness
+    spool_paths = sorted(spools[n.node_id].path for n in nodes)
+    per_node = soak_report.load_spools(spool_paths)
+    report = soak_report.build_report(per_node, legs=4)
+    soak_report.print_summary(report)
+    if sorted(report["nodes"]) != sorted(n.node_id for n in nodes):
+        failures.append(f"report fused nodes {report['nodes']}")
+    empty_legs = [
+        leg["leg"] for leg in report["legs"]
+        if not leg["metrics"].get("critpath/commit", {}).get("n")
+    ]
+    if empty_legs:
+        failures.append(f"legs {empty_legs} carry no commit samples")
+    if not any("restart" in w for w in report["warnings"]):
+        failures.append(
+            f"report missed the victim's restart: {report['warnings']}"
+        )
+    _check_fleet_merge(report, failures)
+
+    # 4. eviction accounting + telemetry exposition, strict lint
+    for n in nodes:
+        if n.cs.flight.evicted() <= 0:
+            failures.append(
+                f"{n.node_id}: flight ring never evicted despite capacity "
+                f"{FLIGHT_CAPACITY} over {TARGET_HEIGHT}+ heights"
+            )
+        _check_exposition(n.node_id, n.metrics.registry.expose_text(),
+                          failures)
+
+    if failures:
+        return _fail(failures)
+
+    # 5. the regression-gate round
+    fleet = report["fleet"]["critpath/commit"]
+    parsed = {
+        "soak_commit_p99_seconds": round(fleet["p99_seconds"], 6),
+        "soak_commit_p50_seconds": round(fleet["p50_seconds"], 6),
+        "soak_commit_samples": fleet["n"],
+        "soak_heights": max(n.height for n in nodes),
+        "soak_snapshots": sum(
+            len(snaps) for snaps in per_node.values()
+        ),
+        "soak_legs": report["n_legs"],
+        "soak_regressions": len(report["regressions"]),
+    }
+    _write_round(_ROOT, parsed)
+    shutil.rmtree(tmp, ignore_errors=True)
+    print(f"[soak-smoke] OK (fleet commit p99 = "
+          f"{parsed['soak_commit_p99_seconds']}s over "
+          f"{parsed['soak_commit_samples']} commits, "
+          f"{parsed['soak_snapshots']} snapshots)")
+    return 0
+
+
+def _fail(failures) -> int:
+    for f in failures:
+        print(f"[soak-smoke] FAIL: {f}", file=sys.stderr)
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -23,7 +23,10 @@ Usage:
         [--limit 256] [--with-trace] [-o merged_trace.json]
 
 The core (`compute_skews` / `merge` / `anchor_spread`) is importable — the
-flight smoke and tests drive it with in-process dumps, no RPC needed.
+flight smoke and tests drive it with in-process dumps, no RPC needed.  The
+CLI itself streams via `write_merged`, which serialises events one at a
+time (byte-identical to `json.dump(merge(...), f)`) so soak-length dumps
+never materialise a second copy of the fleet's event list.
 """
 
 from __future__ import annotations
@@ -348,28 +351,64 @@ def _trace_events(payload: dict, pid: int, skew_ns: int) -> List[dict]:
     return out
 
 
+def iter_merged_events(dumps: List[dict],
+                       traces: Optional[List[Optional[dict]]] = None,
+                       skews: Optional[List[int]] = None):
+    """Yield the merged traceEvents lazily, in exactly the order merge()
+    materialises them: each node's flight track, its retagged dump_trace
+    events, then the cross-node flow arrows last (they need every dump).
+    Peak residency is one node's track plus the arrows — not the fleet."""
+    skews = compute_skews(dumps) if skews is None else skews
+    for pid, (dump, skew) in enumerate(zip(dumps, skews)):
+        yield from _flight_events(dump, pid, skew)
+        if traces is not None and pid < len(traces) and traces[pid]:
+            yield from _trace_events(traces[pid], pid, skew)
+    # cross-node pass: vote-propagation arrows (signer -> each receiver)
+    yield from _flow_events(dumps, skews)
+
+
+def _other_data(dumps: List[dict], skews: List[int]) -> dict:
+    return {
+        "nodes": [d.get("node_id") or f"node{i}"
+                  for i, d in enumerate(dumps)],
+        "skews_ns": list(skews),
+        "alignment_warnings": alignment_warnings(dumps),
+    }
+
+
 def merge(dumps: List[dict], traces: Optional[List[Optional[dict]]] = None,
           skews: Optional[List[int]] = None) -> dict:
     """Fuse per-node dump_flight payloads (and optional index-aligned
     dump_trace payloads) into one Chrome trace-event dict."""
     skews = compute_skews(dumps) if skews is None else skews
-    events: List[dict] = []
-    for pid, (dump, skew) in enumerate(zip(dumps, skews)):
-        events.extend(_flight_events(dump, pid, skew))
-        if traces is not None and pid < len(traces) and traces[pid]:
-            events.extend(_trace_events(traces[pid], pid, skew))
-    # cross-node pass: vote-propagation arrows (signer -> each receiver)
-    events.extend(_flow_events(dumps, skews))
     return {
-        "traceEvents": events,
+        "traceEvents": list(iter_merged_events(dumps, traces, skews=skews)),
         "displayTimeUnit": "ms",
-        "otherData": {
-            "nodes": [d.get("node_id") or f"node{i}"
-                      for i, d in enumerate(dumps)],
-            "skews_ns": list(skews),
-            "alignment_warnings": alignment_warnings(dumps),
-        },
+        "otherData": _other_data(dumps, skews),
     }
+
+
+def write_merged(f, dumps: List[dict],
+                 traces: Optional[List[Optional[dict]]] = None,
+                 skews: Optional[List[int]] = None) -> int:
+    """Stream the merge() document to a text file object one event at a
+    time, byte-identical to ``json.dump(merge(...), f)`` — the scaffolding
+    strings reproduce json.dump's default separators (``", "`` / ``": "``)
+    and top-level key order, and each event is serialised with the same
+    defaults.  Returns the event count (the CLI reports it without ever
+    holding the list)."""
+    skews = compute_skews(dumps) if skews is None else skews
+    f.write('{"traceEvents": [')
+    count = 0
+    for ev in iter_merged_events(dumps, traces, skews=skews):
+        if count:
+            f.write(", ")
+        json.dump(ev, f)
+        count += 1
+    f.write('], "displayTimeUnit": "ms", "otherData": ')
+    json.dump(_other_data(dumps, skews), f)
+    f.write("}")
+    return count
 
 
 # --- CLI -------------------------------------------------------------------
@@ -405,13 +444,12 @@ def main(argv=None) -> int:
         return 2
     dumps, traces = _fetch(endpoints, args.limit, args.with_trace)
     skews = compute_skews(dumps)
-    merged = merge(dumps, traces, skews=skews)
     with open(args.output, "w") as f:
-        json.dump(merged, f)
+        n_events = write_merged(f, dumps, traces, skews=skews)
     spread = anchor_spread(dumps, skews)
     worst = max(spread.values()) if spread else None
     print(
-        f"merged {len(dumps)} nodes, {len(merged['traceEvents'])} events "
+        f"merged {len(dumps)} nodes, {n_events} events "
         f"-> {args.output}"
     )
     print(f"skews_ns={skews} shared_heights={len(spread)} "

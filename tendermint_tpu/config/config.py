@@ -316,6 +316,16 @@ class InstrumentationConfig:
     watchdog_interval: float = 1.0
     watchdog_stall_factor: float = 5.0
     watchdog_min_stall_seconds: float = 10.0
+    # crash-safe telemetry spool (libs/telemetry.py): a background flusher
+    # appends one checksummed snapshot every N heights or T seconds to a
+    # rotating segment group under the node root
+    telemetry_spool: bool = False
+    telemetry_spool_path: str = "data/telemetry/spool"
+    telemetry_spool_interval_heights: int = 20
+    telemetry_spool_interval_seconds: float = 5.0
+    telemetry_spool_head_size_limit: int = 10 * 1024 * 1024
+    telemetry_spool_total_size_limit: int = 256 * 1024 * 1024
+    telemetry_spool_ring_capacity: int = 256
 
 
 @dataclass

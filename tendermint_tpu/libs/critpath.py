@@ -48,6 +48,8 @@ import math
 import threading
 from typing import Dict, List, Optional, Sequence
 
+from tendermint_tpu.libs.sketch import QuantileSketch
+
 # Phase chain of the waterfall, in canonical (chain) order.  The order is
 # load-bearing twice: trace_merge emits slices in it, and critical-path
 # ties break toward the earlier entry.
@@ -239,6 +241,13 @@ class CritPath:
         self._evicted = 0
         self._samples: Dict[str, List[float]] = {}
         self._commit_samples: List[float] = []
+        # whole-run mergeable sketches next to the exact rolling windows:
+        # the windows answer "lately", the sketches answer "this run" in
+        # bounded memory and pool exactly across nodes (fixed gamma)
+        self._sketches: Dict[str, QuantileSketch] = {
+            phase: QuantileSketch() for phase in PHASES
+        }
+        self._sketches["commit"] = QuantileSketch()
 
     # control ---------------------------------------------------------------
     def reset(self, capacity: Optional[int] = None) -> None:
@@ -298,9 +307,13 @@ class CritPath:
                 ring.append(secs)
                 if len(ring) > win:
                     del ring[: len(ring) - win]
+                sk = self._sketches.get(phase)
+                if sk is not None:
+                    sk.add(secs)
             self._commit_samples.append(wf["commit_seconds"])
             if len(self._commit_samples) > win:
                 del self._commit_samples[: len(self._commit_samples) - win]
+            self._sketches["commit"].add(wf["commit_seconds"])
 
     # export ----------------------------------------------------------------
     def records(self, limit: Optional[int] = None) -> List[dict]:
@@ -322,17 +335,31 @@ class CritPath:
         out = {}
         for phase in PHASES:
             xs = self._samples.get(phase, ())
-            out[phase] = {
-                "n": len(xs),
-                "p50_seconds": percentile(xs, 50),
-                "p99_seconds": percentile(xs, 99),
-            }
-        out["commit"] = {
-            "n": len(self._commit_samples),
-            "p50_seconds": percentile(self._commit_samples, 50),
-            "p99_seconds": percentile(self._commit_samples, 99),
-        }
+            out[phase] = self._stats_entry(self._sketches[phase], xs)
+        out["commit"] = self._stats_entry(
+            self._sketches["commit"], self._commit_samples)
         return out
+
+    @staticmethod
+    def _stats_entry(sk: QuantileSketch, xs: Sequence[float]) -> dict:
+        """p50/p99 from the whole-run sketch; the exact rolling-window
+        values ride alongside under window_* for continuity."""
+        return {
+            "n": sk.count,
+            "p50_seconds": sk.p50(),
+            "p99_seconds": sk.p99(),
+            "window_n": len(xs),
+            "window_p50_seconds": percentile(xs, 50),
+            "window_p99_seconds": percentile(xs, 99),
+        }
+
+    def sketches(self) -> Dict[str, dict]:
+        """Serialized per-phase + commit sketches (spool / fleet merge)."""
+        with self._mtx:
+            return self._sketches_locked()
+
+    def _sketches_locked(self) -> Dict[str, dict]:
+        return {name: sk.to_dict() for name, sk in self._sketches.items()}
 
     def snapshot(self, limit: Optional[int] = None) -> dict:
         """The dump_critpath RPC payload.  total/records/evicted/stats are
@@ -351,4 +378,5 @@ class CritPath:
                 "truncated": len(recs) < total,
                 "records": recs,
                 "phase_stats": self._phase_stats_locked(),
+                "sketches": self._sketches_locked(),
             }

@@ -701,6 +701,43 @@ class MempoolBatchMetrics:
         self.flushes.add(1.0, (reason,))
 
 
+class TelemetryMetrics:
+    """Soak-telemetry spool health (libs/telemetry.TelemetrySpool) plus
+    ring-eviction visibility across the bounded observability stores.
+    Per-node (constructed and attached by NodeMetrics, NOT a process
+    singleton): each node owns one spool, and in-process sim nets must
+    not pool their spool byte gauges."""
+
+    def __init__(self, registry: Optional[Registry] = None):
+        r = registry or Registry()
+        self.registry = r
+        self.snapshots = r.counter(
+            "telemetry_snapshots_total",
+            "Telemetry snapshots appended to the on-disk spool",
+        )
+        self.spool_bytes = r.gauge(
+            "telemetry_spool_bytes",
+            "On-disk size of the telemetry spool across all segments",
+        )
+        self.write_errors = r.counter(
+            "telemetry_write_errors_total",
+            "Telemetry snapshot appends that failed (disk errors)",
+        )
+        self.dropped = r.counter(
+            "telemetry_dropped_snapshots_total",
+            "Telemetry snapshots dropped before reaching the spool "
+            "(serialization failures / flusher shutdown races)",
+        )
+        # ring-eviction visibility: the flight recorder, profile ledger
+        # and CritPath/QuorumTrace rings all silently evict under soak
+        # load — soak_report flags lossy legs off these counters
+        self.evicted = r.counter(
+            "observability_evicted_total",
+            "Records evicted from bounded observability stores",
+            label_names=("store",),
+        )
+
+
 _mempool_batch_mtx = threading.Lock()
 _mempool_batch_metrics: Optional[MempoolBatchMetrics] = None
 
@@ -913,6 +950,10 @@ class NodeMetrics:
         r.attach(self.vote_batch.registry)
         self.mempool_batch = get_mempool_batch_metrics()
         r.attach(self.mempool_batch.registry)
+        # per-node telemetry spool family (see TelemetryMetrics docstring
+        # for why this one is NOT a process singleton)
+        self.telemetry = TelemetryMetrics()
+        r.attach(self.telemetry.registry)
         self._last_block_time: Optional[float] = None
         # cardinality hygiene: at most MAX_PEER_LABELS distinct peer ids ever
         # get their own label value; the rest collapse into "overflow"

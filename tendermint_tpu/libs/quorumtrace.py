@@ -42,6 +42,7 @@ import threading
 from typing import Dict, List, Optional, Sequence
 
 from tendermint_tpu.libs.critpath import percentile
+from tendermint_tpu.libs.sketch import QuantileSketch
 
 VOTE_KINDS = ("prevote", "precommit")
 
@@ -291,14 +292,21 @@ def gossip_ledger(
     out_links = []
     for (peer, node), entry in sorted(links.items()):
         lat = entry.pop("latency_s")
+        # per-link latency through the mergeable sketch (soak_report pools
+        # links fleet-wide); the exact values stay under window_* keys
+        sk = QuantileSketch()
+        sk.extend(lat)
         out_links.append({
             "peer": peer,
             "node": node,
             "first_sightings": entry["first"],
             "duplicates": entry["dup"],
-            "latency_p50_s": percentile(lat, 50),
-            "latency_p99_s": percentile(lat, 99),
+            "latency_p50_s": sk.p50(),
+            "latency_p99_s": sk.p99(),
+            "window_latency_p50_s": percentile(lat, 50),
+            "window_latency_p99_s": percentile(lat, 99),
             "latency_samples": len(lat),
+            "latency_sketch": sk.to_dict(),
         })
     return {
         "links": out_links,
@@ -367,6 +375,13 @@ class QuorumTrace:
         # kind -> rolling [seconds] rings for the two crossing thresholds
         self._third_samples: Dict[str, List[float]] = {}
         self._two_thirds_samples: Dict[str, List[float]] = {}
+        # whole-run mergeable sketches next to the exact rolling windows
+        # (fixed gamma: two nodes' sketches pool exactly in soak_report)
+        self._sketches: Dict[str, QuantileSketch] = {
+            f"{kind}_{name}": QuantileSketch()
+            for kind in VOTE_KINDS
+            for name in ("third", "two_thirds")
+        }
 
     # control ---------------------------------------------------------------
     def reset(self, capacity: Optional[int] = None) -> None:
@@ -483,6 +498,7 @@ class QuorumTrace:
                     xs.append(mark["seconds"])
                     if len(xs) > win:
                         del xs[: len(xs) - win]
+                    self._sketches[f"{kind}_{name}"].add(mark["seconds"])
 
     # export ----------------------------------------------------------------
     def records(self, limit: Optional[int] = None) -> List[dict]:
@@ -505,14 +521,31 @@ class QuorumTrace:
         for kind in VOTE_KINDS:
             third = self._third_samples.get(kind, ())
             two = self._two_thirds_samples.get(kind, ())
+            sk_third = self._sketches[f"{kind}_third"]
+            sk_two = self._sketches[f"{kind}_two_thirds"]
             out[kind] = {
-                "n": len(two),
-                "third_p50_seconds": percentile(third, 50),
-                "third_p99_seconds": percentile(third, 99),
-                "two_thirds_p50_seconds": percentile(two, 50),
-                "two_thirds_p99_seconds": percentile(two, 99),
+                # whole-run sketch values lead; the exact rolling-window
+                # values ride alongside under window_* for continuity
+                "n": sk_two.count,
+                "third_p50_seconds": sk_third.p50(),
+                "third_p99_seconds": sk_third.p99(),
+                "two_thirds_p50_seconds": sk_two.p50(),
+                "two_thirds_p99_seconds": sk_two.p99(),
+                "window_n": len(two),
+                "window_third_p50_seconds": percentile(third, 50),
+                "window_third_p99_seconds": percentile(third, 99),
+                "window_two_thirds_p50_seconds": percentile(two, 50),
+                "window_two_thirds_p99_seconds": percentile(two, 99),
             }
         return out
+
+    def sketches(self) -> Dict[str, dict]:
+        """Serialized time-to-quorum sketches (spool / fleet merge)."""
+        with self._mtx:
+            return self._sketches_locked()
+
+    def _sketches_locked(self) -> Dict[str, dict]:
+        return {name: sk.to_dict() for name, sk in self._sketches.items()}
 
     def snapshot(self, limit: Optional[int] = None) -> dict:
         """The dump_quorum RPC payload, under ONE lock acquisition so the
@@ -530,4 +563,5 @@ class QuorumTrace:
                 "truncated": len(recs) < total,
                 "records": recs,
                 "quorum_stats": self._quorum_stats_locked(),
+                "sketches": self._sketches_locked(),
             }

@@ -34,6 +34,11 @@ DEFAULT_INTERVAL = 1.0  # seconds between checks
 DEFAULT_STALL_FACTOR = 5.0  # stall when idle > factor × block-interval EWMA
 DEFAULT_MIN_STALL_SECONDS = 10.0  # ...but never sooner than this
 DEFAULT_EWMA_ALPHA = 0.3
+# A single block-interval sample never contributes more than this multiple
+# of the current EWMA.  One pathological gap (a frozen-then-resumed clock,
+# a multi-minute snapshot restore) would otherwise poison the EWMA and
+# inflate the stall threshold for many blocks afterwards.
+DEFAULT_MAX_SAMPLE_FACTOR = 10.0
 
 
 class LivenessWatchdog:
@@ -50,6 +55,7 @@ class LivenessWatchdog:
         stall_factor: float = DEFAULT_STALL_FACTOR,
         min_stall_seconds: float = DEFAULT_MIN_STALL_SECONDS,
         ewma_alpha: float = DEFAULT_EWMA_ALPHA,
+        max_sample_factor: float = DEFAULT_MAX_SAMPLE_FACTOR,
         logger: Optional[logging.Logger] = None,
         now_ns=None,
     ):
@@ -64,6 +70,7 @@ class LivenessWatchdog:
         self.stall_factor = stall_factor
         self.min_stall_seconds = min_stall_seconds
         self.ewma_alpha = ewma_alpha
+        self.max_sample_factor = max_sample_factor
         self.logger = logger or logging.getLogger("watchdog")
 
         self._mtx = threading.Lock()
@@ -118,11 +125,19 @@ class LivenessWatchdog:
                     # blocks, slow sampling): amortize, or one long gap
                     # poisons the EWMA and inflates the stall threshold
                     dt = (now - self._last_height_at) / (hr[0] - self._last_hr[0])
-                    self._ewma = (
-                        dt
-                        if self._ewma is None
-                        else self.ewma_alpha * dt + (1 - self.ewma_alpha) * self._ewma
-                    )
+                    if self._ewma is None:
+                        self._ewma = dt
+                    else:
+                        # clamp the sample: a frozen-then-resumed clock (or
+                        # any single multi-minute gap) must not swamp the
+                        # average — the stall threshold would stay inflated
+                        # long after blocks resumed at normal pace
+                        if self.max_sample_factor > 0:
+                            dt = min(dt, self.max_sample_factor * self._ewma)
+                        self._ewma = (
+                            self.ewma_alpha * dt
+                            + (1 - self.ewma_alpha) * self._ewma
+                        )
                 if hr[0] != self._last_hr[0]:
                     self._last_height_at = now
                 self._last_hr = hr

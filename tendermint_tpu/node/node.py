@@ -289,6 +289,40 @@ class Node(BaseService):
         if config.instrumentation.flight_recorder:
             self.consensus_state.flight.enable()
         self.watchdog = None
+        # crash-safe telemetry spool (libs/telemetry.py): built here so the
+        # torn-tail recovery truncate runs before anything else appends;
+        # the flusher thread starts in on_start
+        self.telemetry_spool = None
+        if config.instrumentation.telemetry_spool:
+            from tendermint_tpu.libs.telemetry import (
+                TelemetrySpool,
+                node_sources,
+            )
+
+            inst = config.instrumentation
+            spool_path = inst.telemetry_spool_path
+            if not os.path.isabs(spool_path):
+                spool_path = os.path.join(config.base.root_dir, spool_path)
+            self.telemetry_spool = TelemetrySpool(
+                spool_path,
+                node_id=config.base.moniker,
+                interval_heights=inst.telemetry_spool_interval_heights,
+                interval_seconds=inst.telemetry_spool_interval_seconds,
+                head_size_limit=inst.telemetry_spool_head_size_limit,
+                total_size_limit=inst.telemetry_spool_total_size_limit,
+                ring_capacity=inst.telemetry_spool_ring_capacity,
+                metrics=(
+                    self.metrics.telemetry
+                    if self.metrics is not None
+                    else None
+                ),
+                height_fn=lambda: self.consensus_state.rs.height,
+            )
+            for name, fn in node_sources(self).items():
+                self.telemetry_spool.set_source(name, fn)
+            self.telemetry_spool.set_source(
+                "spool", self.telemetry_spool.status
+            )
 
         # p2p: transport + switch + reactors (node.go:372-471). Disabled
         # (single-node) when p2p.laddr is empty — node.go:246-252's
@@ -629,6 +663,8 @@ class Node(BaseService):
                 logger=self.logger,
             )
             self.watchdog.start()
+        if self.telemetry_spool is not None:
+            self.telemetry_spool.start()
         self.logger.info("node started chain_id=%s", self.genesis_doc.chain_id)
 
     def _p2p_metrics_pump(self) -> None:
@@ -650,8 +686,9 @@ class Node(BaseService):
             _t.sleep(1.0)
 
     def on_stop(self) -> None:
-        # switch first: it stops its reactors, which stop the consensus state
-        services = [self.watchdog]
+        # spool first while the analyzers are still live: its stop() writes
+        # one final "shutdown" snapshot closing the run's last leg
+        services = [self.telemetry_spool, self.watchdog]
         services += [self.switch] if self.switch is not None else [self.consensus_state]
         services += [self.rpc_server, self.grpc_broadcast, self.indexer_service,
                      self.event_bus, self.proxy_app, self.signer_endpoint]

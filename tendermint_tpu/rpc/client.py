@@ -171,6 +171,17 @@ class HTTPClient:
             **({"capacity": capacity} if capacity is not None else {}),
         )
 
+    def dump_telemetry(self, limit: Optional[int] = None) -> dict:
+        return self.call(
+            "dump_telemetry", **({"limit": limit} if limit is not None else {})
+        )
+
+    def telemetry_reset(self, capacity: Optional[int] = None) -> dict:
+        return self.call(
+            "telemetry_reset",
+            **({"capacity": capacity} if capacity is not None else {}),
+        )
+
     def dump_device_health(self) -> dict:
         return self.call("dump_device_health")
 

@@ -838,6 +838,44 @@ class RPCEnv:
         cp.reset(capacity)
         return {"capacity": cp.capacity}
 
+    def dump_telemetry(self, limit=None) -> dict:
+        """Snapshot the telemetry spool's in-memory ring (newest periodic
+        snapshots plus spool health; libs/telemetry.py) — the live
+        counterpart of reading the on-disk spool segments offline.
+        limit=N keeps the newest N snapshots.  Gated like dump_flight —
+        snapshots embed eviction/ledger internals."""
+        self._require_unsafe()
+        if limit is not None:
+            limit = int(limit)
+            if limit < 0:
+                raise RPCError(-32602, "limit must be >= 0")
+        spool = getattr(self.node, "telemetry_spool", None)
+        if spool is None:
+            raise RPCError(
+                -32603,
+                "telemetry spool not running "
+                "(instrumentation.telemetry_spool)",
+            )
+        return spool.snapshot(limit)
+
+    def telemetry_reset(self, capacity=None) -> dict:
+        """Clear the telemetry spool's in-memory snapshot ring and health
+        counters; optionally resize the ring (capacity=N).  The on-disk
+        spool segments are history and are NOT touched."""
+        self._require_unsafe()
+        spool = getattr(self.node, "telemetry_spool", None)
+        if spool is None:
+            raise RPCError(
+                -32603,
+                "telemetry spool not running "
+                "(instrumentation.telemetry_spool)",
+            )
+        if capacity is not None:
+            capacity = int(capacity)
+            if capacity < 1:
+                raise RPCError(-32602, "capacity must be >= 1")
+        return spool.reset(capacity)
+
     def dump_mempool_qos(self) -> dict:
         """Per-peer mempool admission ledger (token levels, drops by
         reason, mute state), lane occupancy, and the RPC broadcast

@@ -119,6 +119,21 @@ def _quorum_column(metrics: Dict[str, float]) -> str:
     return f"{1e3 * total / count:.0f}ms"
 
 
+def _spool_column(metrics: Dict[str, float]) -> str:
+    """Telemetry-spool health from the tendermint_telemetry_* families:
+    `N@SIZE` (snapshots written @ on-disk bytes), suffixed `!E` when any
+    write/drop errors accumulated; "-" when the spool is not running."""
+    snaps = _sum_family(metrics, "tendermint_telemetry_snapshots_total")
+    size = _sum_family(metrics, "tendermint_telemetry_spool_bytes")
+    if snaps <= 0 and size <= 0:
+        return "-"
+    errs = _sum_family(
+        metrics, "tendermint_telemetry_write_errors_total"
+    ) + _sum_family(metrics, "tendermint_telemetry_dropped_snapshots_total")
+    out = f"{snaps:.0f}@{_fmt_bytes(size)}"
+    return f"{out}!{errs:.0f}" if errs > 0 else out
+
+
 def _crit_column(metrics: Dict[str, float]) -> str:
     """Dominant commit-path phase from the height_phase_seconds family:
     `phase avg_ms` where avg is the per-height mean of the phase with the
@@ -172,6 +187,9 @@ class NodeMonitor:
         # quorum column (tendermint_consensus_quorum_time_to_two_thirds_
         # seconds): mean time-to-strict-2/3 across vote kinds, or "-"
         self.quorum = "-"
+        # telemetry-spool column (tendermint_telemetry_*): snapshots
+        # written @ spool bytes, error-suffixed; "-" when spooling is off
+        self.spool = "-"
         self._last_block_at: Optional[float] = None
         self._started = time.monotonic()
         self._online_time = 0.0
@@ -238,6 +256,7 @@ class NodeMonitor:
         )
         self.crit = _crit_column(m)
         self.quorum = _quorum_column(m)
+        self.spool = _spool_column(m)
 
     def _connect_ws(self) -> None:
         try:
@@ -294,6 +313,7 @@ class NodeMonitor:
             "device_fallbacks": self.device_fallbacks,
             "crit": self.crit,
             "quorum": self.quorum,
+            "spool": self.spool,
             "uptime_pct": self.uptime_pct,
         }
 
@@ -382,7 +402,7 @@ def main(argv=None) -> int:
                       f"height {snap['max_height']})")
                 print(f"{'MONIKER':<16}{'HEIGHT':>8}{'INTERVAL':>10}"
                       f"{'VERIFY':>14}{'DEVICE':>10}{'CRIT':>15}"
-                      f"{'QUORUM':>8}"
+                      f"{'QUORUM':>8}{'SPOOL':>12}"
                       f"{'TRAFFIC':>10}{'STALL':>9}{'UPTIME':>8}  ADDR")
                 for n in snap["nodes"]:
                     if n["online"]:
@@ -406,6 +426,7 @@ def main(argv=None) -> int:
                         f"{_fmt_device(n['device_state'], n['device_fallbacks']):>10}"
                         f"{n['crit']:>15}"
                         f"{n.get('quorum', '-'):>8}"
+                        f"{n.get('spool', '-'):>12}"
                         f"{_fmt_bytes(n['traffic_bytes']):>10}"
                         f"{stall:>9}"
                         f"{n['uptime_pct']:>7}%  "

@@ -410,11 +410,16 @@ class TestCritPath:
         cut = cp.snapshot(limit=1)
         assert cut["truncated"] is True
         assert len(cut["records"]) == 1 and cut["total_records"] == 3
-        # sample_window=4 bounds the percentile rings below record count
+        # sample_window=4 bounds the exact percentile rings below record
+        # count, while the whole-run sketch keeps all 5 heights
         stats = snap["phase_stats"]
-        assert stats["commit"]["n"] == 4
-        assert all(stats[p]["n"] == 4 for p in PHASES)
+        assert stats["commit"]["window_n"] == 4
+        assert all(stats[p]["window_n"] == 4 for p in PHASES)
+        assert stats["commit"]["n"] == 5
+        assert all(stats[p]["n"] == 5 for p in PHASES)
         assert stats["commit"]["p50_seconds"] > 0.0
+        assert stats["commit"]["window_p50_seconds"] > 0.0
+        assert snap["sketches"]["commit"]["count"] == 5
 
     def test_reset_and_resize(self):
         clock = _Clock()

@@ -364,6 +364,34 @@ class TestWatchdogSampling:
         assert wd.status()["block_interval_ewma_seconds"] is None
         assert wd.check(now=5.5) is None  # idle clock restarted
 
+    def test_ewma_clamps_frozen_clock_gap(self, cs):
+        """A frozen-then-resumed clock (one huge inter-height gap) must not
+        poison the EWMA: the sample is clamped to max_sample_factor × the
+        current EWMA, so the stall threshold recovers immediately."""
+        wd = self._wd(cs, max_sample_factor=10.0)
+        wd.check(now=0.0)
+        cs.rs.height += 1
+        wd.check(now=1.0)  # seeds EWMA at 1s/height
+        assert wd.status()["block_interval_ewma_seconds"] == 1.0
+        # the clock freezes for 10 minutes, then one height lands
+        cs.rs.height += 1
+        wd.check(now=601.0)
+        # unclamped: 0.5*600 + 0.5*1 = 300.5s EWMA, threshold 601s —
+        # clamped: the 600s sample contributes at most 10×1s
+        assert wd.status()["block_interval_ewma_seconds"] == 5.5
+        assert wd.threshold() == 11.0  # 2.0 factor * 5.5s
+        # normal cadence resumes; the average settles back down fast
+        cs.rs.height += 1
+        wd.check(now=602.0)
+        assert wd.status()["block_interval_ewma_seconds"] == 3.25
+        # the unclamped first sample still seeds the EWMA (there is no
+        # baseline to clamp against)
+        wd2 = self._wd(cs)
+        wd2.check(now=0.0)
+        cs.rs.height += 1
+        wd2.check(now=600.0)
+        assert wd2.status()["block_interval_ewma_seconds"] == 600.0
+
 
 class TestWatchdogStallHarness:
     def test_silenced_majority_trips_watchdog(self):
@@ -647,6 +675,26 @@ class TestTraceMerge:
             by_height.setdefault(e["args"]["height"], []).append(e["ts"])
         for ts in by_height.values():
             assert len(ts) == 2 and abs(ts[0] - ts[1]) < 1e-6
+
+    def test_streamed_write_byte_identical_to_json_dump(self, tm):
+        import io
+        import json
+
+        base = [(1, "AA", 1_000_000_000), (2, "BB", 2_000_000_000)]
+        dumps = [_mk_dump("n0", base), _mk_dump("n1", base, skew_ns=7_000)]
+        traces = [None, {
+            "anchor": {"wall_ns": 2_000_000_000, "perf_ns": 500_000_000},
+            "traceEvents": [{"name": "span", "ph": "X", "pid": 9, "tid": 7,
+                             "ts": 100.0, "dur": 5.0}],
+        }]
+        for d, t in [(dumps, None), (dumps, traces), ([], None),
+                     ([_mk_dump("n0", [])], None)]:
+            ref = io.StringIO()
+            json.dump(tm.merge(d, t), ref)
+            streamed = io.StringIO()
+            n = tm.write_merged(streamed, d, t)
+            assert streamed.getvalue() == ref.getvalue()
+            assert n == len(tm.merge(d, t)["traceEvents"])
 
     def test_trace_events_rebased_to_wall_clock(self, tm):
         payload = {

@@ -1,0 +1,311 @@
+"""Quantile sketch + telemetry spool tests (libs/sketch.py, libs/telemetry.py).
+
+Tiers:
+  * accuracy tier: the DDSketch relative-error guarantee checked against
+    exact nearest-rank percentiles over adversarial distributions —
+    constant, bimodal, heavy-tail, single-sample — at every decile plus
+    the tails;
+  * algebra tier: merge associativity/commutativity must be BIT-EXACT on
+    the bucket table (the fixed-gamma contract soak_report's fleet fusion
+    rests on), serde roundtrips, alpha-mismatch refusal, and the
+    WindowedCounter companion's bounded-retention accounting;
+  * spool tier: frame encode/scan, torn-tail recovery (reopen truncates,
+    pre-tear frames stay byte-identical, post-tear appends are readable),
+    rotation across segments, and the single-lock snapshot contract.
+"""
+
+import json
+import math
+import os
+import random
+import struct
+
+import pytest
+
+from tendermint_tpu.libs.sketch import (
+    DEFAULT_RELATIVE_ACCURACY,
+    QuantileSketch,
+    WindowedCounter,
+)
+from tendermint_tpu.libs.telemetry import (
+    TelemetrySpool,
+    encode_record,
+    read_spool,
+    spool_segments,
+)
+
+
+def exact_percentile(xs, q):
+    ordered = sorted(xs)
+    rank = max(1, math.ceil(q * len(ordered)))
+    return ordered[rank - 1]
+
+
+def adversarial_distributions():
+    rng = random.Random(97)
+    return {
+        "constant": [0.25] * 500,
+        "single-sample": [3.7],
+        "two-sample": [1e-6, 1e3],
+        "bimodal": [0.001] * 400 + [10.0] * 100,
+        "heavy-tail": [rng.paretovariate(1.2) for _ in range(2000)],
+        "uniform": [rng.uniform(1e-4, 1.0) for _ in range(1000)],
+        "nine-decades": [10.0 ** rng.uniform(-6, 3) for _ in range(1000)],
+    }
+
+
+class TestSketchAccuracy:
+    @pytest.mark.parametrize("name,xs",
+                             sorted(adversarial_distributions().items()))
+    def test_relative_error_bound(self, name, xs):
+        sk = QuantileSketch()
+        sk.extend(xs)
+        assert sk.count == len(xs)
+        for q in [0.01, 0.25, 0.5, 0.75, 0.9, 0.95, 0.99, 0.999, 1.0]:
+            est = sk.quantile(q)
+            truth = exact_percentile(xs, q)
+            assert abs(est - truth) <= sk.alpha * truth + 1e-12, (
+                f"{name}: q={q} est={est} exact={truth}"
+            )
+
+    def test_order_independence(self):
+        xs = adversarial_distributions()["heavy-tail"]
+        a, b = QuantileSketch(), QuantileSketch()
+        a.extend(xs)
+        b.extend(reversed(xs))
+        assert a.to_dict()["buckets"] == b.to_dict()["buckets"]
+        assert a.p99() == b.p99()
+
+    def test_min_max_clamp_makes_single_sample_exact(self):
+        sk = QuantileSketch()
+        sk.add(3.7)
+        assert sk.quantile(0.0) == 3.7
+        assert sk.p50() == 3.7
+        assert sk.p99() == 3.7
+
+    def test_zero_and_negative_and_nonfinite(self):
+        sk = QuantileSketch()
+        sk.add(0.0)
+        sk.add(-5.0)       # clamped: durations cannot be negative
+        sk.add(float("nan"))   # skipped
+        sk.add(float("inf"))   # skipped
+        assert sk.count == 2
+        assert sk.p99() == 0.0
+        sk.add(1.0)
+        assert sk.p50() == 0.0  # rank 2 of [0, 0, 1]
+        assert sk.p99() == pytest.approx(1.0, rel=sk.alpha)
+
+    def test_bounded_memory_over_decades(self):
+        sk = QuantileSketch()
+        rng = random.Random(5)
+        for _ in range(50_000):
+            sk.add(10.0 ** rng.uniform(-6, 3))
+        # nine decades of range at alpha=0.01 stays near
+        # log_gamma(1e9) ~ 1036 buckets no matter the sample count
+        assert sk.bucket_count() < 1200
+
+    def test_quantile_validation(self):
+        sk = QuantileSketch()
+        with pytest.raises(ValueError):
+            sk.quantile(1.5)
+        with pytest.raises(ValueError):
+            QuantileSketch(alpha=1.0)
+        assert sk.quantile(0.5) == 0.0  # empty
+
+
+class TestSketchAlgebra:
+    def _parts(self):
+        rng = random.Random(11)
+        parts = []
+        for mu in (0.01, 1.0, 50.0):
+            sk = QuantileSketch()
+            sk.extend(rng.lognormvariate(math.log(mu), 1.0)
+                      for _ in range(500))
+            parts.append(sk)
+        return parts
+
+    @staticmethod
+    def _key(sk):
+        d = sk.to_dict()
+        return (d["count"], d["zero"], d["min"], d["max"],
+                tuple(map(tuple, d["buckets"])))
+
+    def test_merge_commutative_and_associative_bit_exact(self):
+        a, b, c = self._parts()
+        ab_c = QuantileSketch.merged([a, b])
+        ab_c.merge(c)
+        a_bc = QuantileSketch.merged([b, c])
+        a_bc.merge(a)
+        c_b_a = QuantileSketch.merged([c, b, a])
+        assert self._key(ab_c) == self._key(a_bc) == self._key(c_b_a)
+        # the merged sketch equals one sketch fed every sample directly
+        # (bucket-exact: merging IS bucket-wise addition)
+        rng = random.Random(11)
+        direct = QuantileSketch()
+        for mu in (0.01, 1.0, 50.0):
+            direct.extend(rng.lognormvariate(math.log(mu), 1.0)
+                          for _ in range(500))
+        assert self._key(direct) == self._key(ab_c)
+
+    def test_merge_alpha_mismatch_refused(self):
+        with pytest.raises(ValueError):
+            QuantileSketch(0.01).merge(QuantileSketch(0.02))
+
+    def test_merged_of_nothing(self):
+        sk = QuantileSketch.merged([])
+        assert sk.count == 0
+        assert sk.alpha == DEFAULT_RELATIVE_ACCURACY
+
+    def test_serde_roundtrip(self):
+        for xs in adversarial_distributions().values():
+            sk = QuantileSketch()
+            sk.extend(xs)
+            d = json.loads(json.dumps(sk.to_dict(), sort_keys=True))
+            back = QuantileSketch.from_dict(d)
+            assert self._key(back) == self._key(sk)
+            assert back.sum == sk.sum
+            assert back.p99() == sk.p99()
+        with pytest.raises(ValueError):
+            QuantileSketch.from_dict({"kind": "histogram"})
+
+
+class TestWindowedCounter:
+    def test_observe_merge_evict(self):
+        wc = WindowedCounter(window=10.0, max_windows=3)
+        for pos in (1, 11, 21, 5, 15):
+            wc.observe(pos)
+        assert wc.total == 5
+        assert wc.evicted == 0
+        assert wc.windows() == [(0, 2), (1, 2), (2, 1)]
+        wc.observe(35)  # fourth window: oldest (2 events) evicts
+        assert wc.evicted == 2
+        assert wc.total == 4
+        other = WindowedCounter(window=10.0, max_windows=3)
+        other.observe(21, count=7)
+        wc.merge(other)
+        assert wc.total == 11
+        d = WindowedCounter.from_dict(
+            json.loads(json.dumps(wc.to_dict())))
+        assert d.windows() == wc.windows()
+        assert d.evicted == wc.evicted
+        with pytest.raises(ValueError):
+            wc.merge(WindowedCounter(window=5.0))
+        with pytest.raises(ValueError):
+            WindowedCounter(window=0.0)
+
+
+class TestTelemetrySpool:
+    def _spool(self, tmp_path, **kw):
+        kw.setdefault("interval_seconds", 0.0)
+        kw.setdefault("interval_heights", 0)
+        return TelemetrySpool(str(tmp_path / "spool"), node_id="n0", **kw)
+
+    def test_flush_and_read_roundtrip(self, tmp_path):
+        sp = self._spool(tmp_path)
+        sp.set_source("stats", lambda: {"height": 7})
+        for _ in range(5):
+            sp.flush()
+        sp.stop()  # appends the shutdown snapshot
+        out = read_spool(str(tmp_path / "spool"))
+        assert out["corrupt_frames"] == 0
+        assert len(out["snapshots"]) == 6
+        assert [s["seq"] for s in out["snapshots"]] == list(range(6))
+        assert out["snapshots"][0]["stats"] == {"height": 7}
+        assert out["snapshots"][-1]["reason"] == "shutdown"
+
+    def test_torn_tail_recovery(self, tmp_path):
+        path = str(tmp_path / "spool")
+        sp = self._spool(tmp_path)
+        for _ in range(3):
+            sp.flush()
+        sp.kill()  # crash: no shutdown snapshot
+        before = read_spool(path)
+        assert len(before["snapshots"]) == 3
+        # tear: half a frame, as a kill mid-write leaves it
+        with open(path, "ab") as f:
+            f.write(encode_record(b'{"torn":true}\n')[:7])
+        torn = read_spool(path)
+        assert len(torn["snapshots"]) == 3  # tail tolerated silently
+        assert torn["corrupt_frames"] == 0
+        # reopen truncates the tear; appends land readable
+        sp2 = self._spool(tmp_path)
+        assert sp2.status()["recovered_bytes"] == 7
+        sp2.flush()
+        sp2.stop()
+        after = read_spool(path)
+        assert after["corrupt_frames"] == 0
+        assert len(after["snapshots"]) == 5
+        assert after["snapshots"][:3] == before["snapshots"]
+
+    def test_mid_file_corruption_counted(self, tmp_path):
+        path = str(tmp_path / "spool")
+        sp = self._spool(tmp_path)
+        for _ in range(2):
+            sp.flush()
+        sp.kill()
+        # flip a payload byte inside the FIRST frame: framing desyncs,
+        # so everything after it is unreadable and counted corrupt
+        data = bytearray(open(path, "rb").read())
+        data[10] ^= 0xFF
+        open(path, "wb").write(bytes(data))
+        out = read_spool(path)
+        assert out["snapshots"] == []
+        assert out["corrupt_frames"] == 1
+
+    def test_rotation_spans_segments(self, tmp_path):
+        path = str(tmp_path / "spool")
+        sp = self._spool(tmp_path, head_size_limit=256,
+                         total_size_limit=1 << 20)
+        sp.set_source("pad", lambda: "x" * 64)
+        for _ in range(10):
+            sp.flush()
+        sp.stop()
+        segs = spool_segments(path)
+        assert len(segs) > 1
+        out = read_spool(path)
+        assert out["segments"] == len(segs)
+        assert out["corrupt_frames"] == 0
+        assert len(out["snapshots"]) == 11
+        assert [s["seq"] for s in out["snapshots"]] == list(range(11))
+
+    def test_snapshot_single_lock_contract(self, tmp_path):
+        sp = self._spool(tmp_path, ring_capacity=4)
+        for _ in range(6):
+            sp.flush()
+        snap = sp.snapshot()
+        assert snap["total_records"] == 4  # ring capacity
+        assert snap["ring_evicted"] > 0
+        assert not snap["truncated"]
+        limited = sp.snapshot(limit=2)
+        assert len(limited["records"]) == 2
+        assert limited["truncated"]
+        assert limited["total_records"] == 4
+        assert sp.snapshot(limit=0)["records"] == []
+        assert sp.reset(capacity=8) == {"ring_capacity": 8}
+        assert sp.snapshot()["total_records"] == 0
+        with pytest.raises(ValueError):
+            sp.reset(capacity=0)
+        sp.stop()
+        # reset touched the ring only — the disk spool kept everything
+        assert len(read_spool(sp.path)["snapshots"]) == 7
+
+    def test_source_failure_isolated(self, tmp_path):
+        sp = self._spool(tmp_path)
+        sp.set_source("good", lambda: 1)
+        sp.set_source("bad", lambda: 1 / 0)
+        snap = sp.flush()
+        assert snap["good"] == 1
+        assert snap["bad"] is None
+        assert sp.status()["source_errors"] == 1
+        sp.stop()
+
+    def test_height_trigger(self, tmp_path):
+        h = {"v": 0}
+        sp = self._spool(tmp_path, interval_heights=5,
+                         height_fn=lambda: h["v"])
+        assert sp.maybe_flush() is None
+        h["v"] = 5
+        snap = sp.maybe_flush()
+        assert snap is not None and snap["reason"] == "heights"
+        assert sp.maybe_flush() is None  # interval restarts at 5
+        sp.kill()
